@@ -1,0 +1,78 @@
+"""Compiled experiment artifact — the common input to both engines.
+
+The reference parses an XML experiment file plus a GraphML topology at
+startup (src/main/core/support/configuration.c, src/main/routing/topology.c)
+and builds igraph structures queried lazily. We instead *compile* the
+experiment on the host into dense numpy tensors once; both the CPU oracle
+engine and the TPU engine consume this identical artifact, which is the
+cross-validation seam mandated by BASELINE.json ("CPU and TPU engines are
+selected from the same config file").
+
+Topology representation: Tor/Bitcoin experiment graphs have few *network*
+vertices (points of presence) with many attached hosts, so we precompute
+all-pairs shortest-path latency/loss over vertices (SURVEY §7.1) and keep a
+host→vertex attachment vector. lat_vv must be strictly positive everywhere:
+its minimum IS the conservative window (the reference computes the same
+runahead bound from minimum link latency in src/main/core/master.c).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CompiledExperiment:
+    n_hosts: int
+    seed: int
+    end_time: int                 # ns
+    lat_vv: np.ndarray            # i64 [V,V] path latency ns, all > 0
+    loss_vv: np.ndarray           # f32 [V,V] end-to-end path loss prob
+    host_vertex: np.ndarray       # i32 [H] vertex each host attaches to
+    bw_up: np.ndarray             # i64 [H] uplink bits/s
+    bw_dn: np.ndarray             # i64 [H] downlink bits/s
+    model: str = "phold"          # workload model name
+    model_cfg: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def window(self) -> int:
+        """Conservative lookahead window = min path latency (runahead)."""
+        return int(self.lat_vv.min())
+
+    def validate(self) -> None:
+        assert self.lat_vv.min() > 0, "zero-latency paths break the conservative window"
+        assert self.lat_vv.shape == self.loss_vv.shape
+        assert self.host_vertex.max() < self.lat_vv.shape[0]
+        assert (self.bw_up > 0).all() and (self.bw_dn > 0).all()
+        assert self.end_time > 0
+
+
+def single_vertex_experiment(
+    n_hosts: int,
+    seed: int,
+    end_time: int,
+    latency_ns: int,
+    loss: float = 0.0,
+    bw_bits: int = 10**9,
+    model: str = "phold",
+    model_cfg: dict | None = None,
+) -> CompiledExperiment:
+    """Minimal topology: every host on one vertex, uniform latency/loss.
+
+    Mirrors the reference's minimal example configs (resource/examples/).
+    """
+    return CompiledExperiment(
+        n_hosts=n_hosts,
+        seed=seed,
+        end_time=end_time,
+        lat_vv=np.full((1, 1), latency_ns, np.int64),
+        loss_vv=np.full((1, 1), loss, np.float32),
+        host_vertex=np.zeros(n_hosts, np.int32),
+        bw_up=np.full(n_hosts, bw_bits, np.int64),
+        bw_dn=np.full(n_hosts, bw_bits, np.int64),
+        model=model,
+        model_cfg=model_cfg or {},
+    )

@@ -1,0 +1,142 @@
+"""Shared constants and engine parameters.
+
+Both engines (cpu_engine and the TPU core) import from here so that the
+simulation *semantics* — event kinds, packet flags, capacity limits, TCP
+constants — are defined exactly once. The reference keeps the analogous
+definitions in ``src/main/core/work/event.c`` (event ordering),
+``src/main/routing/packet.c`` (header fields/flags) and
+``src/main/host/descriptor/tcp.c`` (TCP constants).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# --------------------------------------------------------------------------
+# Simulation time: int64 nanoseconds (reference SimulationTime is 1ns ticks).
+# --------------------------------------------------------------------------
+NS = 1
+US = 1_000
+MS = 1_000_000
+SEC = 1_000_000_000
+
+# --------------------------------------------------------------------------
+# Event kinds. The reference dispatches closures (Task = fn + args,
+# src/main/core/work/task.c); a tensorized engine needs a closed enum of
+# handler kinds instead.
+# --------------------------------------------------------------------------
+K_NONE = 0        # empty slot
+K_PHOLD = 1       # PHOLD benchmark hop (engine stress workload, SURVEY §4)
+K_PKT = 2         # packet arrived at dst NIC (pre receive-queue)
+K_PKT_DELIVER = 3 # packet cleared the NIC receive token bucket; process it
+K_TCP_TIMER = 4   # per-socket retransmit timer check
+K_TX_RESUME = 5   # continue flushing a socket's send buffer (burst bound)
+K_APP = 6         # application state-machine wakeup (p0 = app opcode)
+N_KINDS = 7
+
+# Number of i32 payload columns on every event record.
+NP = 10
+
+# --------------------------------------------------------------------------
+# Packet header flags (rides in the packed p1 column, bits 16..23).
+# --------------------------------------------------------------------------
+F_SYN = 1
+F_ACK = 2
+F_FIN = 4
+F_RST = 8
+F_DGRAM = 16      # datagram (UDP-like) — delivered straight to the app
+
+# Packet event payload layout (p0..p9) — see docs/SEMANTICS.md:
+#   p0 = src_host
+#   p1 = src_sock | dst_sock << 8 | flags << 16
+#   p2 = seq   (u32 wrapping byte offset, stored in i32)
+#   p3 = ack   (u32 wrapping)
+#   p4 = len   (payload bytes modeled; no actual bytes are carried)
+#   p5 = wnd   (advertised receive window, bytes)
+#   p6 = msg_end (u32 wrapping stream offset at which a message completes;
+#                 0 sentinel = no message boundary in this segment)
+#   p7 = msg_meta (opaque app metadata for that message)
+#   p8, p9 = app scratch (datagrams: p8 = meta2)
+
+# Event tie-break key classes (tb column, i64). Pop order is (time, tb)
+# lexicographic — engine-independent, matching the reference's total event
+# order (time, host, seq) in src/main/core/work/event.c (host is implicit
+# here: buffers are per-host already).
+TB_PACKET_BASE = 1 << 62  # packets order after same-time local events
+
+
+def packet_tb(src_host: int, src_ctr: int) -> int:
+    """Deterministic tie-break for a delivered packet event.
+
+    Depends only on (src_host, per-src packet counter), so the CPU oracle
+    (which schedules arrivals eagerly at send time) and the TPU engine
+    (which scatters arrivals at window end) assign identical keys.
+    """
+    return TB_PACKET_BASE + (src_host << 32) + (src_ctr & 0xFFFFFFFF)
+
+
+# --------------------------------------------------------------------------
+# RNG purpose domains (counter-based keys: fold_in(seed, purpose, host, ctr)).
+# Draws are order-independent so both engines reproduce identical streams.
+# The reference gives each host a seeded RNG (src/main/host/host.c).
+# --------------------------------------------------------------------------
+R_PHOLD_DELAY = 1
+R_PHOLD_DST = 2
+R_LOSS = 3
+R_APP = 4
+R_TOR_PATH = 5
+R_BTC = 6
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Static engine capacities and protocol constants.
+
+    Shape-affecting fields are static (hashable dataclass → usable as a jit
+    static argument). Both engines honour the same capacity bounds, but *which*
+    items drop on overflow is engine-specific (eager order vs window-batch rank)
+    — cross-engine parity is guaranteed only when the overflow counters are 0,
+    which is what the metrics exist to police (docs/SEMANTICS.md §capacities).
+    """
+
+    # Per-host event buffer capacity (slots).
+    ev_cap: int = 64
+    # Per-host per-window packet outbox capacity.
+    outbox_cap: int = 64
+    # Sockets per host.
+    sockets_per_host: int = 16
+    # Per-socket in-flight message-boundary FIFO capacity.
+    msgq_cap: int = 32
+    # Max packets a single handler invocation may emit before it must yield
+    # (schedules K_TX_RESUME at the same timestamp to continue).
+    send_burst: int = 4
+    # Max inner rounds per window (safety bound; overflow is counted).
+    max_rounds: int = 256
+
+    # --- TCP constants (reference: src/main/host/descriptor/tcp.c) ---
+    mss: int = 1460               # bytes per segment
+    init_cwnd_mss: int = 10       # RFC6928 initial window
+    sndbuf: int = 131072          # send buffer bytes
+    rcvbuf: int = 131072          # advertised receive window (apps drain fast)
+    rto_min: int = 200 * MS
+    rto_max: int = 60 * SEC
+    rto_init: int = 1 * SEC
+    dupack_thresh: int = 3
+
+    def __post_init__(self):
+        assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
+
+
+# TCP connection states (reference tcp.c state machine).
+TCP_FREE = 0
+TCP_LISTEN = 1
+TCP_SYN_SENT = 2
+TCP_SYN_RCVD = 3
+TCP_ESTABLISHED = 4
+TCP_FIN_WAIT_1 = 5
+TCP_FIN_WAIT_2 = 6
+TCP_CLOSE_WAIT = 7
+TCP_LAST_ACK = 8
+TCP_CLOSING = 9
+TCP_TIME_WAIT = 10
+TCP_CLOSED = 11

@@ -1,0 +1,138 @@
+"""Batched event buffers — the tensorized per-host priority queues.
+
+The reference gives every host a binary-heap event queue and a locked async
+queue for cross-thread pushes (src/main/core/scheduler/*,
+src/main/utility/priority-queue.c). Here all H queues live in one set of
+fixed-capacity SoA tensors ``[H, C]``; pop-min is a masked two-stage argmin,
+local push writes the first free slot, and cross-host delivery is a sorted
+batch scatter performed once per conservative window (SURVEY §7.1).
+
+Total event order matches the reference's (time, host, seq) comparator
+(src/main/core/work/event.c): within a host, events pop by (time, tb) where
+``tb`` is a deterministic tie-break assigned at creation — local pushes use
+the host's own monotone counter, delivered packets use
+``consts.packet_tb(src_host, src_pkt_counter)``. Both engines compute the
+same keys, so event order is engine-independent.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import K_NONE, NP
+
+I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+class EventBuf(NamedTuple):
+    time: jnp.ndarray      # i64 [H, C]
+    tb: jnp.ndarray        # i64 [H, C] tie-break key
+    kind: jnp.ndarray      # i32 [H, C] (K_NONE = free slot)
+    p: jnp.ndarray         # i32 [H, C, NP] payload columns
+    self_ctr: jnp.ndarray  # i64 [H] counter for locally-pushed tb keys
+
+
+class Popped(NamedTuple):
+    mask: jnp.ndarray   # bool [H] — host had an eligible event this round
+    time: jnp.ndarray   # i64 [H]
+    kind: jnp.ndarray   # i32 [H] (K_NONE where ~mask)
+    p: jnp.ndarray      # i32 [H, NP]
+
+
+def evbuf_init(n_hosts: int, cap: int) -> EventBuf:
+    return EventBuf(
+        time=jnp.full((n_hosts, cap), I64_MAX, jnp.int64),
+        tb=jnp.zeros((n_hosts, cap), jnp.int64),
+        kind=jnp.full((n_hosts, cap), K_NONE, jnp.int32),
+        p=jnp.zeros((n_hosts, cap, NP), jnp.int32),
+        self_ctr=jnp.zeros(n_hosts, jnp.int64),
+    )
+
+
+def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarray]:
+    """Push one event per host where ``mask``; tb from the host's own counter.
+
+    Returns (buf, overflow_mask). Overflowing events are dropped and must be
+    surfaced as a metric — capacity is an experiment knob (SURVEY §7.3.2).
+    """
+    h = jnp.arange(buf.time.shape[0])
+    free = buf.kind == K_NONE
+    has_free = free.any(axis=1)
+    slot = jnp.argmax(free, axis=1)
+    ok = mask & has_free
+    # Out-of-range slot index + mode="drop" implements the write mask.
+    slot = jnp.where(ok, slot, buf.time.shape[1])
+    buf = buf._replace(
+        time=buf.time.at[h, slot].set(time, mode="drop"),
+        tb=buf.tb.at[h, slot].set(buf.self_ctr, mode="drop"),
+        kind=buf.kind.at[h, slot].set(kind, mode="drop"),
+        p=buf.p.at[h, slot].set(p, mode="drop"),
+        self_ctr=buf.self_ctr + ok.astype(jnp.int64),
+    )
+    return buf, mask & ~has_free
+
+
+def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
+    """Per-host pop of the minimum-(time, tb) event with time < until."""
+    h = jnp.arange(buf.time.shape[0])
+    elig = (buf.kind != K_NONE) & (buf.time < until)
+    t_masked = jnp.where(elig, buf.time, I64_MAX)
+    min_t = t_masked.min(axis=1)
+    mask = elig.any(axis=1)
+    tie = elig & (t_masked == min_t[:, None])
+    tb_masked = jnp.where(tie, buf.tb, I64_MAX)
+    slot = jnp.argmin(tb_masked, axis=1)
+    ev = Popped(
+        mask=mask,
+        time=jnp.where(mask, min_t, 0),
+        kind=jnp.where(mask, buf.kind[h, slot], K_NONE),
+        p=jnp.where(mask[:, None], buf.p[h, slot], 0),
+    )
+    slot = jnp.where(mask, slot, buf.time.shape[1])
+    buf = buf._replace(
+        kind=buf.kind.at[h, slot].set(K_NONE, mode="drop"),
+        time=buf.time.at[h, slot].set(I64_MAX, mode="drop"),
+    )
+    return buf, ev
+
+
+def any_eligible(buf: EventBuf, until) -> jnp.ndarray:
+    return ((buf.kind != K_NONE) & (buf.time < until)).any()
+
+
+def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf, jnp.ndarray]:
+    """Scatter N externally-created events into their hosts' buffers.
+
+    This is the tensor analogue of the reference's locked cross-thread event
+    push (src/main/utility/async-priority-queue.c): sort by destination, rank
+    within each destination segment, and write each event into its host's
+    r-th free slot. All (dst, slot) targets are distinct by construction, so
+    the scatter is conflict-free. Returns (buf, n_overflow).
+    """
+    n_hosts, cap = buf.time.shape
+    n = dst.shape[0]
+    order = jnp.argsort(jnp.where(mask, dst, n_hosts), stable=True)
+    dst_s = dst[order]
+    mask_s = mask[order]
+    # Rank within destination segment.
+    idx = jnp.arange(n)
+    is_start = jnp.concatenate([jnp.array([True]), dst_s[1:] != dst_s[:-1]])
+    seg_start = jnp.maximum.accumulate(jnp.where(is_start, idx, 0))
+    rank = idx - seg_start
+    # r-th free slot per host: sort slots so free ones come first.
+    free = buf.kind == K_NONE
+    free_cnt = free.sum(axis=1)
+    slot_order = jnp.argsort(~free, axis=1, stable=True)  # [H, C], free slots first
+    ok = mask_s & (rank < free_cnt[jnp.where(mask_s, dst_s, 0)])
+    slot = slot_order[jnp.where(ok, dst_s, 0), jnp.minimum(rank, cap - 1)]
+    d = jnp.where(ok, dst_s, n_hosts)
+    s = jnp.where(ok, slot, cap)
+    buf = buf._replace(
+        time=buf.time.at[d, s].set(time[order], mode="drop"),
+        tb=buf.tb.at[d, s].set(tb[order], mode="drop"),
+        kind=buf.kind.at[d, s].set(kind[order], mode="drop"),
+        p=buf.p.at[d, s].set(p[order], mode="drop"),
+    )
+    return buf, (mask_s & ~ok).sum()

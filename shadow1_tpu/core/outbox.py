@@ -1,0 +1,70 @@
+"""Per-host per-window packet outboxes.
+
+In the reference, a packet send walks NIC → topology path lookup → a locked
+push onto the destination host's queue (SURVEY §3.3, src/main/routing/
+topology.c + core/scheduler). Conservative windows guarantee every
+cross-host event lands at least one window in the future, so the batched
+engine buffers all sends of a window here and performs routing (latency
+gather, loss draws) plus the destination scatter once per window — and, when
+sharded, exactly one all_to_all per window over ICI (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import NP
+
+
+class Outbox(NamedTuple):
+    dst: jnp.ndarray      # i32 [H, P]
+    kind: jnp.ndarray     # i32 [H, P] event kind to deliver at dst
+    depart: jnp.ndarray   # i64 [H, P] time the packet leaves the src NIC
+    ctr: jnp.ndarray      # i64 [H, P] per-src lifetime packet counter
+    p: jnp.ndarray        # i32 [H, P, NP]
+    cnt: jnp.ndarray      # i32 [H] entries used this window
+    pkt_ctr: jnp.ndarray  # i64 [H] lifetime per-src packet counter
+
+
+def outbox_init(n_hosts: int, cap: int) -> Outbox:
+    return Outbox(
+        dst=jnp.zeros((n_hosts, cap), jnp.int32),
+        kind=jnp.zeros((n_hosts, cap), jnp.int32),
+        depart=jnp.zeros((n_hosts, cap), jnp.int64),
+        ctr=jnp.zeros((n_hosts, cap), jnp.int64),
+        p=jnp.zeros((n_hosts, cap, NP), jnp.int32),
+        cnt=jnp.zeros(n_hosts, jnp.int32),
+        pkt_ctr=jnp.zeros(n_hosts, jnp.int64),
+    )
+
+
+def outbox_space(ob: Outbox) -> jnp.ndarray:
+    return ob.dst.shape[1] - ob.cnt
+
+
+def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.ndarray]:
+    """Append one packet per host where ``mask``. Returns (ob, ok_mask).
+
+    Callers that cannot tolerate drops (TCP) must check ``outbox_space``
+    first and defer to the next window instead (K_TX_RESUME).
+    """
+    h = jnp.arange(ob.dst.shape[0])
+    cap = ob.dst.shape[1]
+    ok = mask & (ob.cnt < cap)
+    slot = jnp.where(ok, ob.cnt, cap)
+    ob = ob._replace(
+        dst=ob.dst.at[h, slot].set(dst, mode="drop"),
+        kind=ob.kind.at[h, slot].set(kind, mode="drop"),
+        depart=ob.depart.at[h, slot].set(depart, mode="drop"),
+        ctr=ob.ctr.at[h, slot].set(ob.pkt_ctr, mode="drop"),
+        p=ob.p.at[h, slot].set(p, mode="drop"),
+        cnt=ob.cnt + ok.astype(jnp.int32),
+        pkt_ctr=ob.pkt_ctr + ok.astype(jnp.int64),
+    )
+    return ob, ok
+
+
+def outbox_clear(ob: Outbox) -> Outbox:
+    return ob._replace(cnt=jnp.zeros_like(ob.cnt))

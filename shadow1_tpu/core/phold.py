@@ -1,0 +1,83 @@
+"""PHOLD — the classic parallel-DES stress workload, in tensors.
+
+The reference exercises its scheduler policies with a PHOLD-style benchmark
+(SURVEY §4, src/test/phold/): every host holds live events; executing one
+draws an exponential delay and a uniformly random destination and schedules
+the next hop there. It stresses exactly the machinery this engine batches —
+pop-min, cross-host push, window barriers — with no network stack on top.
+
+model_cfg: ``mean_delay_ns`` (float), ``init_events`` (events seeded per
+host at t=0, default 1).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu import rng
+from shadow1_tpu.consts import K_PHOLD, NP, R_PHOLD_DELAY, R_PHOLD_DST
+from shadow1_tpu.core.events import EventBuf, Popped, push_local
+from shadow1_tpu.core.outbox import outbox_append
+
+
+class PholdState(NamedTuple):
+    hops: jnp.ndarray  # i64 [H] events executed per host
+    ctr: jnp.ndarray   # i64 [H] per-host draw counter
+
+
+def init(ctx, evbuf: EventBuf):
+    n = int(ctx.model_cfg.get("init_events", 1))
+    zero_p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    all_hosts = jnp.ones(ctx.n_hosts, bool)
+    t0 = jnp.zeros(ctx.n_hosts, jnp.int64)
+    k = jnp.full(ctx.n_hosts, K_PHOLD, jnp.int32)
+    seed_over = jnp.zeros((), jnp.int64)
+    for _ in range(n):
+        evbuf, over = push_local(evbuf, all_hosts, t0, k, zero_p)
+        seed_over = seed_over + over.sum(dtype=jnp.int64)
+    state = PholdState(
+        hops=jnp.zeros(ctx.n_hosts, jnp.int64),
+        ctr=jnp.zeros(ctx.n_hosts, jnp.int64),
+    )
+    return state, evbuf, seed_over
+
+
+def make_handlers(ctx):
+    mean = float(ctx.model_cfg["mean_delay_ns"])
+    hosts = ctx.hosts
+
+    def on_phold(st, ev: Popped):
+        m = ev.mask & (ev.kind == K_PHOLD)
+        model: PholdState = st.model
+        delay = rng.exponential_ns(
+            rng.bits_v(ctx.key, R_PHOLD_DELAY, hosts, model.ctr), mean
+        )
+        dst = rng.randint(rng.bits_v(ctx.key, R_PHOLD_DST, hosts, model.ctr), ctx.n_hosts)
+        t_next = ev.time + delay
+        zero_p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+        k = jnp.full(ctx.n_hosts, K_PHOLD, jnp.int32)
+        local = m & (dst == hosts)
+        evbuf, over = push_local(st.evbuf, local, t_next, k, zero_p)
+        remote = m & ~local
+        outbox, ok = outbox_append(st.outbox, remote, dst, k, t_next, zero_p)
+        met = st.metrics
+        return st._replace(
+            evbuf=evbuf,
+            outbox=outbox,
+            model=PholdState(
+                hops=model.hops + m.astype(jnp.int64),
+                ctr=model.ctr + m.astype(jnp.int64),
+            ),
+            metrics=met._replace(
+                ev_overflow=met.ev_overflow + over.sum(dtype=jnp.int64),
+                ob_overflow=met.ob_overflow + (remote & ~ok).sum(dtype=jnp.int64),
+            ),
+        )
+
+    return {K_PHOLD: on_phold}
+
+
+def summary(model: PholdState) -> dict:
+    return {"hops": model.hops, "total_hops": model.hops.sum()}

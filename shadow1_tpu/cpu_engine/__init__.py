@@ -1,0 +1,1 @@
+from shadow1_tpu.cpu_engine.engine import CpuEngine  # noqa: F401

@@ -1,0 +1,162 @@
+"""CPU reference engine — the readable discrete-event oracle.
+
+A small, sequential heapq simulator implementing *exactly* the semantics in
+docs/SEMANTICS.md: same event ordering keys, same capacity bounds, same
+counter-based RNG streams, same integer arithmetic. It plays the role the
+real Linux kernel played for the reference's test strategy (SURVEY §4: "the
+real OS is the oracle"): every workload must produce identical event/packet/
+byte counts and final clocks on this engine and on the batched TPU engine.
+
+Structurally it mirrors the reference's sequential scheduler policy
+(src/main/core/scheduler/scheduler-policy-global-single.c): one global
+priority queue, events executed in total (time, tb) order.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any
+
+import numpy as np
+
+from shadow1_tpu.config.compiled import CompiledExperiment
+from shadow1_tpu.consts import (
+    K_PHOLD,
+    R_LOSS,
+    R_PHOLD_DELAY,
+    R_PHOLD_DST,
+    EngineParams,
+    packet_tb,
+)
+from shadow1_tpu.cpu_engine.rngcache import DrawCache
+
+
+class CpuEngine:
+    def __init__(self, exp: CompiledExperiment, params: EngineParams | None = None):
+        exp.validate()
+        self.exp = exp
+        self.params = params or EngineParams()
+        self.window = exp.window
+        self.n_windows = int(-(-exp.end_time // self.window))
+        self.draws = DrawCache(exp.seed)
+        h = exp.n_hosts
+        self.heap: list[tuple] = []  # (time, tb, gseq, host, kind, p)
+        self._gseq = 0
+        self.pending = np.zeros(h, np.int64)   # events queued per host (ev_cap)
+        self.self_ctr = np.zeros(h, np.int64)  # local push tie-break counters
+        self.pkt_ctr = np.zeros(h, np.int64)   # per-src packet counters
+        self._ob_win = np.full(h, -1, np.int64)  # outbox accounting: window idx
+        self._ob_used = np.zeros(h, np.int64)    # ... sends used this window
+        self.metrics = {
+            "events": 0,
+            "pkts_sent": 0,
+            "pkts_delivered": 0,
+            "pkts_lost": 0,
+            "ev_overflow": 0,
+            "ob_overflow": 0,
+        }
+        self.model = self._make_model()
+        self.model.start()
+
+    def _make_model(self):
+        if self.exp.model == "phold":
+            return CpuPhold(self)
+        if self.exp.model == "net":
+            from shadow1_tpu.cpu_engine.net import CpuNetModel
+
+            return CpuNetModel(self)
+        raise ValueError(f"unknown model {self.exp.model!r}")
+
+    # -- scheduling primitives (semantics shared with the TPU engine) -----
+    def schedule_local(self, host: int, time: int, kind: int, p: tuple) -> None:
+        if self.pending[host] >= self.params.ev_cap:
+            self.metrics["ev_overflow"] += 1
+            return
+        tb = int(self.self_ctr[host])
+        self.self_ctr[host] += 1
+        self._push(time, tb, host, kind, p)
+
+    def outbox_space(self, host: int, now: int) -> int:
+        w = now // self.window
+        if self._ob_win[host] != w:
+            self._ob_win[host] = w
+            self._ob_used[host] = 0
+        return self.params.outbox_cap - int(self._ob_used[host])
+
+    def send(self, src: int, dst: int, kind: int, depart: int, p: tuple, now: int) -> bool:
+        """Route one packet: NIC outbox accounting, path latency, loss draw.
+
+        ``depart`` is the time the packet leaves the src NIC; the outbox slot
+        is consumed in the window containing the handler's ``now`` (the TPU
+        engine drains and resets outboxes at each window end).
+        """
+        if self.outbox_space(src, now) <= 0:
+            self.metrics["ob_overflow"] += 1
+            return False
+        self._ob_used[src] += 1
+        ctr = int(self.pkt_ctr[src])
+        self.pkt_ctr[src] += 1
+        self.metrics["pkts_sent"] += 1
+        vs = int(self.exp.host_vertex[src])
+        vd = int(self.exp.host_vertex[dst])
+        if self.draws.uniform(R_LOSS, src, ctr) < float(self.exp.loss_vv[vs, vd]):
+            self.metrics["pkts_lost"] += 1
+            return True
+        arrival = depart + int(self.exp.lat_vv[vs, vd])
+        if self.pending[dst] >= self.params.ev_cap:
+            self.metrics["ev_overflow"] += 1
+            return True
+        self._push(arrival, packet_tb(src, ctr), dst, kind, p)
+        self.metrics["pkts_delivered"] += 1
+        return True
+
+    def _push(self, time: int, tb: int, host: int, kind: int, p: tuple) -> None:
+        self.pending[host] += 1
+        heapq.heappush(self.heap, (time, tb, self._gseq, host, kind, p))
+        self._gseq += 1
+
+    # -- main loop ---------------------------------------------------------
+    def run(self, n_windows: int | None = None) -> dict[str, Any]:
+        end = (n_windows or self.n_windows) * self.window
+        while self.heap and self.heap[0][0] < end:
+            time, _tb, _g, host, kind, p = heapq.heappop(self.heap)
+            self.pending[host] -= 1
+            self.metrics["events"] += 1
+            self.model.handle(host, time, kind, p)
+        return dict(self.metrics)
+
+    def summary(self) -> dict[str, Any]:
+        return self.model.summary()
+
+
+class CpuPhold:
+    """Oracle PHOLD (semantics mirror of shadow1_tpu.core.phold)."""
+
+    def __init__(self, eng: CpuEngine):
+        self.eng = eng
+        cfg = eng.exp.model_cfg
+        self.mean = float(cfg["mean_delay_ns"])
+        self.init_events = int(cfg.get("init_events", 1))
+        self.hops = np.zeros(eng.exp.n_hosts, np.int64)
+        self.ctr = np.zeros(eng.exp.n_hosts, np.int64)
+
+    def start(self) -> None:
+        for h in range(self.eng.exp.n_hosts):
+            for _ in range(self.init_events):
+                self.eng.schedule_local(h, 0, K_PHOLD, ())
+
+    def handle(self, host: int, time: int, kind: int, p: tuple) -> None:
+        d = self.eng.draws
+        ctr = int(self.ctr[host])
+        delay = d.exponential_ns(R_PHOLD_DELAY, host, ctr, self.mean)
+        dst = d.randint(R_PHOLD_DST, host, ctr, self.eng.exp.n_hosts)
+        self.ctr[host] += 1
+        self.hops[host] += 1
+        t_next = time + delay
+        if dst == host:
+            self.eng.schedule_local(host, t_next, K_PHOLD, ())
+        else:
+            self.eng.send(host, dst, K_PHOLD, t_next, (), now=time)
+
+    def summary(self) -> dict[str, Any]:
+        return {"hops": self.hops, "total_hops": int(self.hops.sum())}

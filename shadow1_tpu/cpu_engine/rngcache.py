@@ -1,0 +1,43 @@
+"""Block-cached counter RNG draws for the CPU oracle.
+
+The oracle consumes draws one at a time; issuing one eager JAX call per draw
+would dominate its runtime. Draws are pure functions of (purpose, host,
+counter), so we batch-compute blocks of consecutive counters with the exact
+same jnp transforms the TPU engine traces (shadow1_tpu.rng) and cache them —
+bit-identical values, amortized dispatch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu import rng
+
+_BLOCK = 256
+
+
+class DrawCache:
+    def __init__(self, seed: int):
+        self.key = rng.base_key(seed)
+        self._bits: dict[tuple, np.ndarray] = {}
+
+    def bits(self, purpose: int, host: int, ctr: int) -> np.uint32:
+        blk = ctr // _BLOCK
+        k = (purpose, host, blk)
+        got = self._bits.get(k)
+        if got is None:
+            ctrs = jnp.arange(blk * _BLOCK, (blk + 1) * _BLOCK)
+            hosts = jnp.full(_BLOCK, host)
+            got = np.asarray(rng.bits_v(self.key, purpose, hosts, ctrs))
+            self._bits[k] = got
+        return got[ctr % _BLOCK]
+
+    def uniform(self, purpose: int, host: int, ctr: int) -> float:
+        return float(rng.uniform01(jnp.uint32(self.bits(purpose, host, ctr))))
+
+    def exponential_ns(self, purpose: int, host: int, ctr: int, mean_ns: float) -> int:
+        return int(rng.exponential_ns(jnp.uint32(self.bits(purpose, host, ctr)), mean_ns))
+
+    def randint(self, purpose: int, host: int, ctr: int, n: int) -> int:
+        return int(rng.randint(jnp.uint32(self.bits(purpose, host, ctr)), n))

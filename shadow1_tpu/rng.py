@@ -1,0 +1,59 @@
+"""Counter-based deterministic randomness shared by both engines.
+
+The reference gives every host its own seeded RNG (src/main/host/host.c) so
+results are independent of worker scheduling. We go one step further: every
+draw is a pure function of ``(seed, purpose, host, counter)`` via Threefry
+``fold_in`` — order-independent, so the eager CPU oracle and the batched TPU
+engine produce bit-identical streams no matter when each computes its draws.
+
+All transforms from raw bits to values use minimal float chains (a single
+multiply, or log+multiply) to keep eager-vs-jit rounding identical; the
+parity tests in tests/ are the guard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def base_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(np.uint32(seed))
+
+
+def _key(seed_key: jax.Array, purpose, host, ctr) -> jax.Array:
+    k = jax.random.fold_in(seed_key, purpose)
+    k = jax.random.fold_in(k, host)
+    return jax.random.fold_in(k, ctr)
+
+
+def bits(seed_key, purpose, host, ctr) -> jax.Array:
+    """One u32 of raw randomness for (purpose, host, ctr). Scalar in, scalar out."""
+    return jax.random.bits(_key(seed_key, purpose, host, ctr), (), jnp.uint32)
+
+
+# Vectorized over (host, ctr) arrays — used by the TPU engine.
+bits_v = jax.vmap(bits, in_axes=(None, None, 0, 0))
+
+
+def uniform01(b: jax.Array) -> jax.Array:
+    """u32 bits → float32 in [0, 1). Single exact multiply."""
+    return b.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+
+
+def exponential_ns(b: jax.Array, mean_ns) -> jax.Array:
+    """u32 bits → int64 ns exponential with the given mean.
+
+    Uses -mean * log1p(-u); clamped to ≥ 1 ns so events always advance time.
+    """
+    u = uniform01(b)
+    d = -jnp.float32(mean_ns) * jnp.log1p(-u)
+    return jnp.maximum(d.astype(jnp.int64), 1)
+
+
+def randint(b: jax.Array, n) -> jax.Array:
+    """u32 bits → integer in [0, n) via 64-bit multiply-shift (exact, no bias
+    for n ≪ 2^32 beyond the standard multiply-shift approximation; identical
+    in both engines)."""
+    return ((b.astype(jnp.uint64) * jnp.uint64(n)) >> jnp.uint64(32)).astype(jnp.int32)

@@ -1,0 +1,24 @@
+"""Test harness setup.
+
+Tests run on the CPU platform with 8 virtual devices so multi-chip sharding
+paths compile and execute without TPU hardware (the driver separately
+dry-runs them via __graft_entry__.dryrun_multichip). Must run before jax
+imports anywhere.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import shadow1_tpu  # noqa: E402,F401  (enables x64 before any jax array exists)
+import jax  # noqa: E402
+
+# The environment pre-sets JAX_PLATFORMS=axon (the TPU plugin) in a way that
+# wins over os.environ mutation; the config route reliably forces CPU.
+jax.config.update("jax_platforms", "cpu")
