@@ -127,6 +127,50 @@ class EngineParams:
         assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
 
 
+# App notification flags (per-round, host-level — set by the transport layer,
+# consumed by the app layer in the same round; the tensor analogue of the
+# reference's descriptor status-bit → epoll → plugin callback chain,
+# src/main/host/descriptor/descriptor.c + epoll.c, SURVEY §3.4).
+N_ESTABLISHED = 1   # client: connect completed
+N_ACCEPTED = 2      # server: child socket entered ESTABLISHED
+N_MSG = 4           # in-order stream delivery crossed a message boundary
+N_SPACE = 8         # send-buffer space became available
+N_PEER_FIN = 16     # peer closed its direction
+N_CLOSED = 32       # connection fully closed
+N_DGRAM = 64        # datagram delivered
+N_DATA = 128        # in-order stream bytes delivered (dlen)
+
+# Wire overhead modeled per packet (IP + TCP headers), bytes.
+WIRE_OVERHEAD = 40
+
+# --- u32 wrapping sequence-number helpers (Python-int flavour, used by the
+# CPU oracle; the TPU engine gets identical semantics from i32 overflow). ---
+_M32 = 0xFFFFFFFF
+
+
+def seq_add(a: int, n: int) -> int:
+    return (a + n) & _M32
+
+
+def seq_sub(a: int, b: int) -> int:
+    """Signed distance a-b in sequence space."""
+    d = (a - b) & _M32
+    return d - (1 << 32) if d >= (1 << 31) else d
+
+
+def seq_lt(a: int, b: int) -> bool:
+    return seq_sub(a, b) < 0
+
+
+def seq_le(a: int, b: int) -> bool:
+    return seq_sub(a, b) <= 0
+
+
+def ser_delay_ns(wire_bytes: int, bw_bits: int) -> int:
+    """Serialization delay of a packet on a link, ns (ceil division)."""
+    return (wire_bytes * 8 * SEC + bw_bits - 1) // bw_bits
+
+
 # TCP connection states (reference tcp.c state machine).
 TCP_FREE = 0
 TCP_LISTEN = 1
@@ -140,3 +184,18 @@ TCP_LAST_ACK = 8
 TCP_CLOSING = 9
 TCP_TIME_WAIT = 10
 TCP_CLOSED = 11
+
+# Shared TCP tuning constants (single source of truth for both engines).
+SSTHRESH_INIT = 1 << 28
+CWND_MAX = 1 << 28
+
+# State sets used by both engines' send/receive paths.
+TCP_SENDABLE_STATES = (
+    TCP_SYN_SENT, TCP_SYN_RCVD, TCP_ESTABLISHED, TCP_CLOSE_WAIT,
+    TCP_FIN_WAIT_1, TCP_LAST_ACK, TCP_CLOSING,
+)
+TCP_CONN_STATES = (
+    TCP_SYN_SENT, TCP_SYN_RCVD, TCP_ESTABLISHED, TCP_FIN_WAIT_1,
+    TCP_FIN_WAIT_2, TCP_CLOSE_WAIT, TCP_LAST_ACK, TCP_CLOSING,
+)
+TCP_RCV_STATES = (TCP_ESTABLISHED, TCP_FIN_WAIT_1, TCP_FIN_WAIT_2)

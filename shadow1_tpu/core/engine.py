@@ -51,6 +51,9 @@ class Metrics(NamedTuple):
     ev_overflow: jnp.ndarray     # events dropped: full event buffer
     ob_overflow: jnp.ndarray     # packets dropped: full outbox
     round_cap_hits: jnp.ndarray  # windows that hit the max_rounds safety cap
+    tcp_fast_rtx: jnp.ndarray    # fast-retransmit (3 dup-ACK) episodes
+    tcp_rto: jnp.ndarray         # retransmit-timeout episodes
+    tcp_ooo_drops: jnp.ndarray   # out-of-order segments dropped (GBN receiver)
 
 
 def _metrics_init() -> Metrics:
@@ -87,6 +90,28 @@ class Ctx:
 
 
 Handler = Callable[[SimState, Popped], SimState]
+
+
+def push_local_event(st: SimState, ctx: Ctx, mask, time, kind, p0=None, p1=None) -> SimState:
+    """Push one local event per host where ``mask``, counting overflow.
+
+    The engine-state-level convenience over events.push_local used by all
+    handler layers (transport timers, app wakeups)."""
+    from shadow1_tpu.core.events import push_local
+    from shadow1_tpu.consts import NP
+
+    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    if p0 is not None:
+        p = p.at[:, 0].set(jnp.asarray(p0, jnp.int32))
+    if p1 is not None:
+        p = p.at[:, 1].set(jnp.asarray(p1, jnp.int32))
+    k = jnp.full(ctx.n_hosts, kind, jnp.int32)
+    evbuf, over = push_local(st.evbuf, mask, time, k, p)
+    m = st.metrics
+    return st._replace(
+        evbuf=evbuf,
+        metrics=m._replace(ev_overflow=m.ev_overflow + over.sum(dtype=jnp.int64)),
+    )
 
 
 def _model_module(name: str):
@@ -238,4 +263,4 @@ class Engine:
         return {k: int(v) for k, v in st.metrics._asdict().items()}
 
     def model_summary(self, st: SimState) -> dict[str, Any]:
-        return jax.tree.map(np.asarray, self._model.summary(st.model))
+        return jax.tree.map(np.asarray, self._model.summary(st.model, self.ctx))

@@ -79,5 +79,5 @@ def make_handlers(ctx):
     return {K_PHOLD: on_phold}
 
 
-def summary(model: PholdState) -> dict:
+def summary(model: PholdState, ctx=None) -> dict:
     return {"hops": model.hops, "total_hops": model.hops.sum()}

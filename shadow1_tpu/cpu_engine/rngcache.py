@@ -21,9 +21,9 @@ class DrawCache:
     def __init__(self, seed: int):
         self.key = rng.base_key(seed)
         self._bits: dict[tuple, np.ndarray] = {}
+        self._xf: dict[tuple, np.ndarray] = {}  # transformed-value blocks
 
-    def bits(self, purpose: int, host: int, ctr: int) -> np.uint32:
-        blk = ctr // _BLOCK
+    def _bits_block(self, purpose: int, host: int, blk: int) -> np.ndarray:
         k = (purpose, host, blk)
         got = self._bits.get(k)
         if got is None:
@@ -31,13 +31,33 @@ class DrawCache:
             hosts = jnp.full(_BLOCK, host)
             got = np.asarray(rng.bits_v(self.key, purpose, hosts, ctrs))
             self._bits[k] = got
-        return got[ctr % _BLOCK]
+        return got
+
+    def bits(self, purpose: int, host: int, ctr: int) -> np.uint32:
+        return self._bits_block(purpose, host, ctr // _BLOCK)[ctr % _BLOCK]
+
+    def _xf_block(self, tag, purpose, host, ctr, fn) -> np.ndarray:
+        """Whole-block transform via the shared jnp code path (one eager call
+        per block instead of one per draw)."""
+        blk = ctr // _BLOCK
+        k = (tag, purpose, host, blk)
+        got = self._xf.get(k)
+        if got is None:
+            b = jnp.asarray(self._bits_block(purpose, host, blk))
+            got = np.asarray(fn(b))
+            self._xf[k] = got
+        return got
 
     def uniform(self, purpose: int, host: int, ctr: int) -> float:
-        return float(rng.uniform01(jnp.uint32(self.bits(purpose, host, ctr))))
+        blk = self._xf_block(("u",), purpose, host, ctr, rng.uniform01)
+        return float(blk[ctr % _BLOCK])
 
     def exponential_ns(self, purpose: int, host: int, ctr: int, mean_ns: float) -> int:
-        return int(rng.exponential_ns(jnp.uint32(self.bits(purpose, host, ctr)), mean_ns))
+        blk = self._xf_block(
+            ("e", mean_ns), purpose, host, ctr, lambda b: rng.exponential_ns(b, mean_ns)
+        )
+        return int(blk[ctr % _BLOCK])
 
     def randint(self, purpose: int, host: int, ctr: int, n: int) -> int:
-        return int(rng.randint(jnp.uint32(self.bits(purpose, host, ctr)), n))
+        blk = self._xf_block(("r", n), purpose, host, ctr, lambda b: rng.randint(b, n))
+        return int(blk[ctr % _BLOCK])

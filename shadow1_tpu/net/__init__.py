@@ -1,0 +1,157 @@
+"""The "net" workload model: NIC + TCP/UDP transport + model applications.
+
+This composes the tensor equivalents of the reference's host stack
+(SURVEY §2.3): NetworkInterface (net/nic.py), the descriptor/TCP subsystem
+(tcp/tcp.py), and the application layer (apps/*) that replaces real plugin
+binaries with state-machine traffic models (the sanctioned substitution,
+SURVEY §2.4). Event flow per arrived packet mirrors the reference call
+stack §3.4: K_PKT (NIC receive queue) → K_PKT_DELIVER (TCP/UDP processing)
+→ app notification → app reaction (sends, closes) in the same round.
+
+model_cfg: ``{"app": <name>, ...app-specific numpy arrays}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import (
+    F_DGRAM,
+    K_APP,
+    K_PKT,
+    K_PKT_DELIVER,
+    K_TCP_TIMER,
+    K_TX_RESUME,
+    N_DGRAM,
+    NP,
+    WIRE_OVERHEAD,
+)
+from shadow1_tpu.core.events import push_local
+from shadow1_tpu.core.outbox import outbox_append
+from shadow1_tpu.net.nic import NicState, nic_init, rx_stamp, tx_stamp
+from shadow1_tpu.tcp import tcp as T
+
+
+class NetState(NamedTuple):
+    nic: NicState
+    tcp: dict
+    app: Any
+
+
+def _app_module(name: str):
+    if name == "filexfer":
+        from shadow1_tpu.apps import filexfer
+
+        return filexfer
+    if name == "dgram":
+        from shadow1_tpu.apps import dgram
+
+        return dgram
+    if name == "tgen":
+        from shadow1_tpu.apps import tgen
+
+        return tgen
+    if name == "tor":
+        from shadow1_tpu.apps import tor
+
+        return tor
+    if name == "bitcoin":
+        from shadow1_tpu.apps import bitcoin
+
+        return bitcoin
+    raise ValueError(f"unknown app {name!r}")
+
+
+def init(ctx, evbuf):
+    pr = ctx.params
+    nic = nic_init(ctx.n_hosts)
+    tcpd = T.tcp_init(ctx.n_hosts, pr.sockets_per_host, pr.msgq_cap, pr)
+    app_mod = _app_module(ctx.model_cfg["app"])
+    app, evbuf, over, tcpd = app_mod.init(ctx, evbuf, tcpd)
+    return NetState(nic=nic, tcp=tcpd, app=app), evbuf, over
+
+
+def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
+    """Datagram send: NIC uplink stamp + outbox packet with F_DGRAM.
+
+    The reference's UDP socket (src/main/host/descriptor/udp.c): no
+    handshake, no reliability; loss/latency/bandwidth still apply.
+    """
+    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    p = p.at[:, 0].set(ctx.hosts)
+    p = p.at[:, 1].set(T.pack_meta(0, dst_sock, F_DGRAM))
+    p = p.at[:, 4].set(jnp.asarray(length, jnp.int32))
+    p = p.at[:, 7].set(jnp.asarray(meta, jnp.int32))
+    p = p.at[:, 8].set(jnp.asarray(meta2, jnp.int32))
+    wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
+    nic, depart = tx_stamp(st.model.nic, mask, wire, now, ctx.bw_up)
+    k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
+    outbox, ok = outbox_append(st.outbox, mask, dst_host, k, depart, p)
+    m = st.metrics
+    return st._replace(
+        model=st.model._replace(nic=nic),
+        outbox=outbox,
+        metrics=m._replace(ob_overflow=m.ob_overflow + (mask & ~ok).sum(dtype=jnp.int64)),
+    )
+
+
+def make_handlers(ctx):
+    app_mod = _app_module(ctx.model_cfg["app"])
+    app_on_notify = app_mod.on_notify
+    app_on_wakeup = app_mod.on_wakeup
+
+    def on_pkt(st, ev):
+        """K_PKT: packet reached the dst NIC — model the receive queue."""
+        m = ev.mask & (ev.kind == K_PKT)
+        wire = jnp.asarray(ev.p[:, 4], jnp.int64) + WIRE_OVERHEAD
+        nic, ready = rx_stamp(st.model.nic, m, wire, ev.time, ctx.bw_dn)
+        st = st._replace(model=st.model._replace(nic=nic))
+        k = jnp.full(ctx.n_hosts, K_PKT_DELIVER, jnp.int32)
+        evbuf, over = push_local(st.evbuf, m, ready, k, ev.p)
+        met = st.metrics
+        return st._replace(
+            evbuf=evbuf,
+            metrics=met._replace(ev_overflow=met.ev_overflow + over.sum(dtype=jnp.int64)),
+        )
+
+    def on_deliver(st, ev):
+        """K_PKT_DELIVER: the packet cleared the NIC — run TCP/UDP, then app."""
+        m = ev.mask & (ev.kind == K_PKT_DELIVER)
+        flags = (ev.p[:, 1] >> 16) & 0xFF
+        is_dgram = (flags & F_DGRAM) != 0
+        st, nf = T.tcp_rx(st, ctx, m & ~is_dgram, ev.p, ev.time)
+        dg = m & is_dgram
+        nf = T._notify(
+            nf, dg, (ev.p[:, 1] >> 8) & 0xFF, N_DGRAM,
+            meta=ev.p[:, 7], meta2=ev.p[:, 8], dlen=ev.p[:, 4],
+        )
+        return app_on_notify(st, ctx, nf, ev.time, nf.flags != 0)
+
+    def on_timer(st, ev):
+        return T.on_tcp_timer(st, ctx, ev)
+
+    def on_txr(st, ev):
+        return T.on_tx_resume(st, ctx, ev)
+
+    def on_app(st, ev):
+        m = ev.mask & (ev.kind == K_APP)
+        return app_on_wakeup(st, ctx, ev, m)
+
+    return {
+        K_PKT: on_pkt,
+        K_PKT_DELIVER: on_deliver,
+        K_TCP_TIMER: on_timer,
+        K_TX_RESUME: on_txr,
+        K_APP: on_app,
+    }
+
+
+def summary(model: NetState, ctx) -> dict:
+    d = {
+        "nic_tx_bytes": model.nic.tx_bytes,
+        "nic_rx_bytes": model.nic.rx_bytes,
+    }
+    d.update(_app_module(ctx.model_cfg["app"]).summary(model.app))
+    return d

@@ -1,0 +1,65 @@
+"""NIC model: per-host token-bucket-style serialization on both directions.
+
+The reference's NetworkInterface (src/main/host/network-interface.c) gives
+each host token-bucket up/down bandwidth with a FIFO send queue. The tensor
+model keeps one "link free at" timestamp per direction per host: a packet of
+wire length L departs at ``max(now, tx_free)`` and occupies the link for
+``ceil(8·L / bw)`` ns; the receive side delays packet *processing* the same
+way (SURVEY §3.3–3.4). This reproduces serialization/queueing delay exactly
+for FIFO order, which is how both engines process packets.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import SEC
+
+
+class NicState(NamedTuple):
+    tx_free: jnp.ndarray   # i64 [H] uplink busy until
+    rx_free: jnp.ndarray   # i64 [H] downlink busy until
+    tx_bytes: jnp.ndarray  # i64 [H]
+    rx_bytes: jnp.ndarray  # i64 [H]
+
+
+def nic_init(n_hosts: int) -> NicState:
+    z = lambda: jnp.zeros(n_hosts, jnp.int64)
+    return NicState(z(), z(), z(), z())
+
+
+def ser_delay(wire_bytes, bw_bits):
+    """ceil(8e9 · bytes / bw) ns — identical integer math in both engines."""
+    w = jnp.asarray(wire_bytes, jnp.int64)
+    return (w * (8 * SEC) + bw_bits - 1) // bw_bits
+
+
+def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up):
+    """Reserve the uplink: returns (nic', depart_time[H])."""
+    depart = jnp.maximum(now, nic.tx_free)
+    busy = depart + ser_delay(wire_bytes, bw_up)
+    w = jnp.asarray(wire_bytes, jnp.int64)
+    return (
+        nic._replace(
+            tx_free=jnp.where(mask, busy, nic.tx_free),
+            tx_bytes=nic.tx_bytes + jnp.where(mask, w, 0),
+        ),
+        depart,
+    )
+
+
+def rx_stamp(nic: NicState, mask, wire_bytes, now, bw_dn):
+    """Reserve the downlink: returns (nic', ready_time[H]) — the time the
+    packet clears the receive queue and may be processed."""
+    ready = jnp.maximum(now, nic.rx_free)
+    busy = ready + ser_delay(wire_bytes, bw_dn)
+    w = jnp.asarray(wire_bytes, jnp.int64)
+    return (
+        nic._replace(
+            rx_free=jnp.where(mask, busy, nic.rx_free),
+            rx_bytes=nic.rx_bytes + jnp.where(mask, w, 0),
+        ),
+        ready,
+    )

@@ -1,0 +1,601 @@
+"""Vectorized virtual TCP — every socket of every host updated in SIMD.
+
+The tensor re-expression of the reference's biggest state machine
+(src/main/host/descriptor/tcp.c, SURVEY §2.3): 3-way handshake, sliding
+window, Reno-style congestion control (slow start, AIMD, fast retransmit on
+3 dup-ACKs, RTO with exponential backoff), RFC6298 integer RTT estimation,
+FIN teardown. State lives in a dict of ``[H, S]`` arrays; every operation
+is a masked gather/scatter over the (host, socket) plane — one packet per
+host per round, all hosts in parallel.
+
+Deliberate model simplifications vs the reference (docs/SEMANTICS.md §tcp):
+
+* Go-Back-N loss recovery: the receiver accepts only in-order segments (no
+  out-of-order reassembly buffer / SACK); on retransmit the sender rewinds
+  ``snd_nxt`` to ``snd_una``. Identical in both engines, so parity is exact;
+  fidelity differs from the reference only under loss.
+* Immediate ACKs (no delayed-ACK timer).
+* Byte counts only — payload contents are never materialized (apps are
+  models); message boundaries ride packets as (end_seq, meta) pairs, at
+  most one per segment.
+
+Sequence space: u32 wrapping (i32 arrays, natural overflow). ISN = 0: SYN
+occupies seq 0, stream byte k is seq 1+k, FIN occupies the seq after the
+last byte.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import (
+    F_ACK,
+    F_FIN,
+    F_SYN,
+    K_PKT,
+    K_TCP_TIMER,
+    K_TX_RESUME,
+    N_ACCEPTED,
+    N_CLOSED,
+    N_DATA,
+    N_ESTABLISHED,
+    N_MSG,
+    N_PEER_FIN,
+    N_SPACE,
+    NP,
+    TCP_CLOSE_WAIT,
+    TCP_CLOSING,
+    TCP_ESTABLISHED,
+    TCP_FIN_WAIT_1,
+    TCP_FIN_WAIT_2,
+    TCP_FREE,
+    TCP_LAST_ACK,
+    TCP_LISTEN,
+    TCP_SYN_RCVD,
+    TCP_SYN_SENT,
+    WIRE_OVERHEAD,
+)
+from shadow1_tpu.consts import (  # noqa: F811 — shared tuning/state sets
+    CWND_MAX,
+    SSTHRESH_INIT,
+    TCP_CONN_STATES,
+    TCP_RCV_STATES,
+    TCP_SENDABLE_STATES,
+)
+from shadow1_tpu.core.outbox import outbox_append, outbox_space
+from shadow1_tpu.net.nic import tx_stamp
+
+# Fields of the TCP state dict, all [H, S] unless noted.
+_FIELDS_I32 = (
+    "st", "peer_host", "peer_sock",
+    "snd_una", "snd_nxt", "rcv_nxt", "app_end",   # seq space (u32 wrap)
+    "fin_pend", "cwnd", "ssthresh", "peer_wnd",
+    "dupacks", "recover", "ts_seq", "txr",
+)
+_FIELDS_I64 = ("srtt", "rttvar", "rto", "rtx_t", "ts_time")
+_FIELDS_BOOL = ("timer_armed", "ts_act")
+
+
+def tcp_init(n_hosts: int, n_socks: int, mq_cap: int, params) -> dict:
+    d = {}
+    for f in _FIELDS_I32:
+        d[f] = jnp.zeros((n_hosts, n_socks), jnp.int32)
+    for f in _FIELDS_I64:
+        d[f] = jnp.zeros((n_hosts, n_socks), jnp.int64)
+    for f in _FIELDS_BOOL:
+        d[f] = jnp.zeros((n_hosts, n_socks), bool)
+    d["mq_valid"] = jnp.zeros((n_hosts, n_socks, mq_cap), bool)
+    d["mq_end"] = jnp.zeros((n_hosts, n_socks, mq_cap), jnp.int32)
+    d["mq_meta"] = jnp.zeros((n_hosts, n_socks, mq_cap), jnp.int32)
+    return d
+
+
+class Sock:
+    """Masked (host → socket) view over the TCP dict: readable sequential
+    code, functional updates underneath. All reads/writes are [H] vectors at
+    [h, sock]; writes apply only where the (possibly narrowed) mask holds."""
+
+    def __init__(self, tcp: dict, sock, mask):
+        self.d = dict(tcp)
+        self.h = jnp.arange(tcp["st"].shape[0])
+        self.S = tcp["st"].shape[1]
+        self.sock = sock
+        self.mask = mask
+
+    def g(self, k):
+        return self.d[k].at[self.h, jnp.where(self.mask, self.sock, 0)].get()
+
+    def s(self, k, val, where=None):
+        m = self.mask if where is None else (self.mask & where)
+        sk = jnp.where(m, self.sock, self.S)
+        self.d[k] = self.d[k].at[self.h, sk].set(
+            jnp.asarray(val, self.d[k].dtype), mode="drop"
+        )
+
+
+class Notif(NamedTuple):
+    """Per-round, per-host transport→app notification (descriptor status
+    bits analogue)."""
+
+    sock: jnp.ndarray   # i32 [H]
+    flags: jnp.ndarray  # i32 [H] bitmask of N_*
+    meta: jnp.ndarray   # i32 [H] message meta (N_MSG / N_DGRAM)
+    meta2: jnp.ndarray  # i32 [H] second dgram meta
+    dlen: jnp.ndarray   # i32 [H] stream/dgram bytes delivered
+    space: jnp.ndarray  # i32 [H] send-buffer space (N_SPACE)
+
+
+def notif_none(n_hosts: int) -> Notif:
+    z = jnp.zeros(n_hosts, jnp.int32)
+    return Notif(z, z, z, z, z, z)
+
+
+def _notify(nf: Notif, mask, sock, flag, meta=None, meta2=None, dlen=None, space=None) -> Notif:
+    upd = lambda cur, v: jnp.where(mask, jnp.asarray(v, jnp.int32), cur)
+    return Notif(
+        sock=upd(nf.sock, sock),
+        flags=jnp.where(mask, nf.flags | flag, nf.flags),
+        meta=nf.meta if meta is None else upd(nf.meta, meta),
+        meta2=nf.meta2 if meta2 is None else upd(nf.meta2, meta2),
+        dlen=nf.dlen if dlen is None else upd(nf.dlen, dlen),
+        space=nf.space if space is None else upd(nf.space, space),
+    )
+
+
+# --------------------------------------------------------------------------
+# Packet emission
+# --------------------------------------------------------------------------
+def pack_meta(src_sock, dst_sock, flags):
+    return (
+        jnp.asarray(src_sock, jnp.int32)
+        | (jnp.asarray(dst_sock, jnp.int32) << 8)
+        | (jnp.asarray(flags, jnp.int32) << 16)
+    )
+
+
+def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
+    """Emit one segment per host where mask: NIC stamp + outbox append.
+
+    Caller must have established outbox space. Returns engine state.
+    """
+    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    p = p.at[:, 0].set(ctx.hosts)
+    p = p.at[:, 1].set(pack_meta(r.sock, r.g("peer_sock"), flags))
+    p = p.at[:, 2].set(seq)
+    p = p.at[:, 3].set(r.g("rcv_nxt"))
+    p = p.at[:, 4].set(jnp.asarray(length, jnp.int32))
+    p = p.at[:, 5].set(ctx.params.rcvbuf)
+    p = p.at[:, 6].set(mend)
+    p = p.at[:, 7].set(mmeta)
+    wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
+    nic, depart = tx_stamp(st.model.nic, mask, wire, now, ctx.bw_up)
+    k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
+    outbox, ok = outbox_append(st.outbox, mask, r.g("peer_host"), k, depart, p)
+    return st._replace(model=st.model._replace(nic=nic), outbox=outbox)
+
+
+from shadow1_tpu.core.engine import push_local_event as _push_local  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# Flush: packetize [snd_nxt, limit) — data, SYN, FIN — up to send_burst segs.
+# --------------------------------------------------------------------------
+_SENDABLE = TCP_SENDABLE_STATES
+
+
+def _state_in(state, states):
+    m = jnp.zeros_like(state, dtype=bool)
+    for s in states:
+        m = m | (state == s)
+    return m
+
+
+def tcp_flush(st, ctx, mask, sock, now):
+    """Send as many pending segments of ``sock`` as burst/window/outbox
+    allow; schedule K_TX_RESUME to continue if still pending."""
+    pr = ctx.params
+    for _ in range(pr.send_burst):
+        r = Sock(st.model.tcp, sock, mask)
+        state = r.g("st")
+        snd_una, snd_nxt = r.g("snd_una"), r.g("snd_nxt")
+        app_end, fin_p = r.g("app_end"), r.g("fin_pend")
+        cwnd, peer_wnd = r.g("cwnd"), r.g("peer_wnd")
+        total_end = app_end + fin_p
+        pending = (snd_nxt - total_end) < 0
+        flight = snd_nxt - snd_una
+        limit = jnp.minimum(cwnd, peer_wnd)
+        wnd_ok = flight < limit
+        can = (
+            mask
+            & _state_in(state, _SENDABLE)
+            & pending
+            & wnd_ok
+            & (outbox_space(st.outbox) > 0)
+        )
+        seg_syn = can & (snd_nxt == 0)
+        seg_fin = can & ~seg_syn & (snd_nxt == app_end) & (fin_p == 1)
+        seg_data = can & ~seg_syn & ~seg_fin
+        length = jnp.where(
+            seg_data,
+            jnp.minimum(
+                jnp.minimum(pr.mss, app_end - snd_nxt), limit - flight
+            ),
+            0,
+        )
+        flags = jnp.where(
+            seg_syn,
+            jnp.where(state == TCP_SYN_RCVD, F_SYN | F_ACK, F_SYN),
+            jnp.where(seg_fin, F_FIN | F_ACK, F_ACK),
+        )
+        # Message boundary riding this segment: min mq end in (snd_nxt, snd_nxt+len].
+        seg_hi = snd_nxt + length
+        mqv, mqe = r.g("mq_valid"), r.g("mq_end")  # [H, MQ]
+        inrange = mqv & ((mqe - snd_nxt[:, None]) > 0) & ((mqe - seg_hi[:, None]) <= 0)
+        has_m = seg_data & inrange.any(axis=1)
+        # distances are positive where inrange; pick the smallest end.
+        dist = jnp.where(inrange, mqe - snd_nxt[:, None], jnp.int32(2**31 - 1))
+        mi = jnp.argmin(dist, axis=1)
+        hh = jnp.arange(ctx.n_hosts)
+        mend = jnp.where(has_m, mqe[hh, mi], 0)
+        mmeta = jnp.where(has_m, r.g("mq_meta")[hh, mi], 0)
+
+        st = _emit(st, ctx, r, can, flags, snd_nxt, length, mend, mmeta, now)
+        new_nxt = snd_nxt + length + jnp.where(seg_syn | seg_fin, 1, 0)
+        r.s("snd_nxt", new_nxt, can)
+        # RTT sample (Karn: one outstanding sample; invalidated on rewinds).
+        take_ts = can & ~r.g("ts_act") & (seg_data | seg_syn | seg_fin)
+        r.s("ts_act", True, take_ts)
+        r.s("ts_seq", new_nxt, take_ts)
+        r.s("ts_time", now, take_ts)
+        # Arm retransmit deadline + lazily ensure one live timer event.
+        arm = can & (r.g("rtx_t") == 0)
+        r.s("rtx_t", now + r.g("rto"), arm)
+        need_ev = arm & ~r.g("timer_armed")
+        r.s("timer_armed", True, need_ev)
+        st = st._replace(model=st.model._replace(tcp=r.d))
+        st = _push_local(
+            st, ctx, need_ev, now + Sock(r.d, sock, mask).g("rto"), K_TCP_TIMER, p0=sock
+        )
+
+    # Still pending but couldn't send → one TX_RESUME per sock (deduped).
+    r = Sock(st.model.tcp, sock, mask)
+    state = r.g("st")
+    snd_nxt, snd_una = r.g("snd_nxt"), r.g("snd_una")
+    total_end = r.g("app_end") + r.g("fin_pend")
+    pending = (snd_nxt - total_end) < 0
+    wnd_ok = (snd_nxt - snd_una) < jnp.minimum(r.g("cwnd"), r.g("peer_wnd"))
+    blocked_outbox = outbox_space(st.outbox) <= 0
+    more = mask & _state_in(state, _SENDABLE) & pending & wnd_ok & ~r.g("txr")
+    # Outbox-blocked sends resume at the next window start (after drain);
+    # burst-limited sends resume immediately (same timestamp, next round).
+    t_resume = jnp.where(
+        blocked_outbox, (now // ctx.window + 1) * ctx.window, now
+    )
+    r.s("txr", 1, more)
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    return _push_local(st, ctx, more, t_resume, K_TX_RESUME, p0=sock)
+
+
+def _ack_now(st, ctx, mask, sock, now):
+    """Emit an immediate pure ACK (no data, no seq consumption)."""
+    r = Sock(st.model.tcp, sock, mask)
+    can = mask & (outbox_space(st.outbox) > 0)
+    z = jnp.zeros(ctx.n_hosts, jnp.int32)
+    return _emit(st, ctx, r, can, jnp.full(ctx.n_hosts, F_ACK, jnp.int32),
+                 r.g("snd_nxt"), z, z, z, now)
+
+
+# --------------------------------------------------------------------------
+# App-facing API (vectorized, masked)
+# --------------------------------------------------------------------------
+def tcp_listen(st, ctx, mask, sock):
+    r = Sock(st.model.tcp, sock, mask)
+    r.s("st", TCP_LISTEN)
+    return st._replace(model=st.model._replace(tcp=r.d))
+
+
+def _init_conn(r: Sock, ctx, mask, peer_host, peer_sock, state, rcv_nxt):
+    pr = ctx.params
+    r.s("st", state, mask)
+    r.s("peer_host", peer_host, mask)
+    r.s("peer_sock", peer_sock, mask)
+    r.s("snd_una", 0, mask)
+    r.s("snd_nxt", 0, mask)
+    r.s("rcv_nxt", rcv_nxt, mask)
+    r.s("app_end", 1, mask)
+    r.s("fin_pend", 0, mask)
+    r.s("cwnd", pr.init_cwnd_mss * pr.mss, mask)
+    r.s("ssthresh", SSTHRESH_INIT, mask)
+    r.s("peer_wnd", pr.mss, mask)  # lets the SYN go out; real wnd learned on first ACK
+    r.s("srtt", 0, mask)
+    r.s("rttvar", 0, mask)
+    r.s("rto", pr.rto_init, mask)
+    r.s("rtx_t", 0, mask)
+    r.s("dupacks", 0, mask)
+    r.s("recover", 0, mask)
+    r.s("ts_act", False, mask)
+    r.s("txr", 0, mask)
+    mq = jnp.where(mask[:, None], False, r.g("mq_valid"))
+    r.s("mq_valid", mq, mask)
+
+
+def tcp_connect(st, ctx, mask, sock, dst_host, dst_sock, now):
+    r = Sock(st.model.tcp, sock, mask)
+    _init_conn(r, ctx, mask, dst_host, dst_sock, TCP_SYN_SENT, 0)
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    return tcp_flush(st, ctx, mask, sock, now)
+
+
+def tcp_send(st, ctx, mask, sock, nbytes, meta, now):
+    """Queue up to ``nbytes`` on the socket (clamped to send-buffer space);
+    attach ``meta`` as a message boundary at the end iff fully queued and
+    meta != 0. Returns (st, accepted[H])."""
+    pr = ctx.params
+    r = Sock(st.model.tcp, sock, mask)
+    snd_una, app_end = r.g("snd_una"), r.g("app_end")
+    buffered = (app_end - snd_una) - (snd_una == 0).astype(jnp.int32)
+    space = jnp.maximum(pr.sndbuf - buffered, 0)
+    accepted = jnp.clip(jnp.asarray(nbytes, jnp.int32), 0, space)
+    accepted = jnp.where(mask, accepted, 0)
+    new_end = app_end + accepted
+    r.s("app_end", new_end, accepted > 0)
+    # Message boundary bookkeeping.
+    want_meta = mask & (accepted > 0) & (accepted == nbytes) & (jnp.asarray(meta, jnp.int32) != 0)
+    mqv = r.g("mq_valid")
+    has_free = ~mqv.all(axis=1)
+    slot = jnp.argmin(mqv, axis=1)
+    ok = want_meta & has_free
+    hh = jnp.arange(ctx.n_hosts)
+    sl = jnp.where(ok, slot, mqv.shape[1])
+    mq_valid = r.d["mq_valid"]
+    # [H, S, MQ] scatter at (h, sock, slot)
+    sk = jnp.where(ok, r.sock, r.S)
+    r.d["mq_valid"] = r.d["mq_valid"].at[hh, sk, sl].set(True, mode="drop")
+    r.d["mq_end"] = r.d["mq_end"].at[hh, sk, sl].set(new_end, mode="drop")
+    r.d["mq_meta"] = r.d["mq_meta"].at[hh, sk, sl].set(jnp.asarray(meta, jnp.int32), mode="drop")
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    st = tcp_flush(st, ctx, mask & (accepted > 0), sock, now)
+    return st, accepted
+
+
+def tcp_close(st, ctx, mask, sock, now):
+    r = Sock(st.model.tcp, sock, mask)
+    state = r.g("st")
+    est = mask & (state == TCP_ESTABLISHED)
+    cw = mask & (state == TCP_CLOSE_WAIT)
+    r.s("st", TCP_FIN_WAIT_1, est)
+    r.s("st", TCP_LAST_ACK, cw)
+    r.s("fin_pend", 1, est | cw)
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    return tcp_flush(st, ctx, est | cw, sock, now)
+
+
+# --------------------------------------------------------------------------
+# Receive path — one packet per host per round, all hosts in parallel.
+# Mirrors the sequencing of the reference's tcp_processPacket (SURVEY §3.4):
+# connection demux → ACK processing (cwnd/RTT/retransmit) → payload →
+# FIN → immediate ACK, then app notifications.
+# --------------------------------------------------------------------------
+_CONN_STATES = TCP_CONN_STATES
+_RCV_STATES = TCP_RCV_STATES
+
+
+def tcp_rx(st, ctx, mask, p, now):
+    """Process one arrived TCP segment per host where ``mask``.
+
+    Returns (st, Notif). ``now`` is the per-host event time vector.
+    """
+    pr = ctx.params
+    H = ctx.n_hosts
+    src = p[:, 0]
+    packed = p[:, 1]
+    ss = packed & 0xFF
+    ds = (packed >> 8) & 0xFF
+    flags = (packed >> 16) & 0xFF
+    seq, ackno, length = p[:, 2], p[:, 3], p[:, 4]
+    wnd, mend, mmeta = p[:, 5], p[:, 6], p[:, 7]
+    is_syn = (flags & F_SYN) != 0
+    is_ack = (flags & F_ACK) != 0
+    is_fin = (flags & F_FIN) != 0
+    nf = notif_none(H)
+
+    # ---- passive open: SYN → LISTEN socket spawns a child (tcp.c accept path)
+    tcp = st.model.tcp
+    r0 = Sock(tcp, ds, mask)
+    syn_to_listen = mask & is_syn & ~is_ack & (r0.g("st") == TCP_LISTEN)
+    dup = (
+        (tcp["peer_host"] == src[:, None])
+        & (tcp["peer_sock"] == ss[:, None])
+        & (tcp["st"] != TCP_FREE)
+        & (tcp["st"] != TCP_LISTEN)
+    ).any(axis=1)
+    free = tcp["st"] == TCP_FREE
+    child = jnp.argmax(free, axis=1).astype(jnp.int32)
+    new_conn = syn_to_listen & ~dup & free.any(axis=1)
+    rc = Sock(tcp, child, new_conn)
+    _init_conn(rc, ctx, new_conn, src, ss, TCP_SYN_RCVD, 1)
+    rc.s("peer_wnd", wnd, new_conn)
+    st = st._replace(model=st.model._replace(tcp=rc.d))
+    st = tcp_flush(st, ctx, new_conn, child, now)  # emits SYN|ACK
+
+    # ---- established-path demux: peer must match (guards stale/reused slots)
+    r = Sock(st.model.tcp, ds, mask)
+    state = r.g("st")
+    # A client in SYN_SENT connected to the *listener*; the SYN|ACK arrives
+    # from the freshly-spawned child socket — accept it by host only and
+    # learn the true peer socket from it.
+    learn_peer = (state == TCP_SYN_SENT) & is_syn & is_ack
+    v = (
+        mask
+        & ~syn_to_listen
+        & _state_in(state, _CONN_STATES)
+        & (r.g("peer_host") == src)
+        & ((r.g("peer_sock") == ss) | learn_peer)
+    )
+    r.s("peer_sock", ss, v & learn_peer)
+    r.s("peer_wnd", jnp.maximum(wnd, 1), v & is_ack)
+
+    # ---- ACK processing
+    a = v & is_ack
+    snd_una, snd_nxt = r.g("snd_una"), r.g("snd_nxt")
+    new_ack = a & ((ackno - snd_una) > 0) & ((ackno - snd_nxt) <= 0)
+    # RTT sample (RFC6298, integer ns; err>>3 is floor division by 8).
+    ts_ok = new_ack & r.g("ts_act") & ((ackno - r.g("ts_seq")) >= 0)
+    rtt = jnp.maximum(now - r.g("ts_time"), 1)
+    first = r.g("srtt") == 0
+    err = rtt - r.g("srtt")
+    srtt_n = jnp.where(first, rtt, r.g("srtt") + (err >> 3))
+    rttvar_n = jnp.where(first, rtt // 2, r.g("rttvar") + ((jnp.abs(err) - r.g("rttvar")) >> 2))
+    rto_n = jnp.clip(srtt_n + jnp.maximum(4 * rttvar_n, 1_000_000), pr.rto_min, pr.rto_max)
+    r.s("srtt", srtt_n, ts_ok)
+    r.s("rttvar", rttvar_n, ts_ok)
+    r.s("rto", rto_n, ts_ok)
+    r.s("ts_act", False, ts_ok)
+    # cwnd growth: slow start below ssthresh, else AIMD (tcp_cong_reno.c).
+    cwnd = r.g("cwnd")
+    grow = jnp.where(
+        cwnd < r.g("ssthresh"), pr.mss, jnp.maximum((pr.mss * pr.mss) // jnp.maximum(cwnd, 1), 1)
+    )
+    r.s("cwnd", jnp.minimum(cwnd + grow, CWND_MAX), new_ack)
+    r.s("snd_una", ackno, new_ack)
+    r.s("dupacks", 0, new_ack)
+    # Retire message boundaries the peer has fully acked.
+    keep = r.g("mq_valid") & ((r.g("mq_end") - ackno[:, None]) > 0)
+    r.s("mq_valid", keep, new_ack)
+    # Restart (or clear) the retransmit deadline.
+    outstanding = (snd_nxt - ackno) > 0
+    r.s("rtx_t", jnp.where(outstanding, now + r.g("rto"), 0), new_ack)
+
+    # State transitions driven by this ACK.
+    est_sr = new_ack & (state == TCP_SYN_RCVD)
+    r.s("st", TCP_ESTABLISHED, est_sr)
+    nf = _notify(nf, est_sr, ds, N_ACCEPTED)
+    est_ss = a & is_syn & (state == TCP_SYN_SENT) & (ackno == 1)
+    r.s("st", TCP_ESTABLISHED, est_ss)
+    r.s("rcv_nxt", 1, est_ss)
+    nf = _notify(nf, est_ss, ds, N_ESTABLISHED)
+    total_end = r.g("app_end") + r.g("fin_pend")
+    fin_acked = new_ack & (r.g("fin_pend") == 1) & (ackno == total_end)
+    r.s("st", TCP_FIN_WAIT_2, fin_acked & (state == TCP_FIN_WAIT_1))
+    closed_by_ack = fin_acked & ((state == TCP_CLOSING) | (state == TCP_LAST_ACK))
+    nf = _notify(nf, closed_by_ack, ds, N_CLOSED)
+    sp = new_ack & ((state == TCP_ESTABLISHED) | (state == TCP_CLOSE_WAIT)) & ~closed_by_ack
+    space = pr.sndbuf - (r.g("app_end") - ackno)
+    nf = _notify(nf, sp, ds, N_SPACE, space=space)
+
+    # Duplicate ACKs → fast retransmit (Go-Back-N rewind) at the threshold.
+    dup_a = a & ~new_ack & (ackno == snd_una) & outstanding & (length == 0) & ~is_syn & ~is_fin
+    dp = r.g("dupacks") + 1
+    r.s("dupacks", dp, dup_a)
+    frx = dup_a & (dp == pr.dupack_thresh) & ((snd_una - r.g("recover")) >= 0)
+    flight = snd_nxt - snd_una
+    ssth = jnp.maximum(flight // 2, 2 * pr.mss)
+    r.s("ssthresh", ssth, frx)
+    r.s("cwnd", ssth, frx)
+    r.s("recover", snd_nxt, frx)
+    r.s("snd_nxt", snd_una, frx)
+    r.s("ts_act", False, frx)
+
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    met = st.metrics
+    st = st._replace(metrics=met._replace(
+        tcp_fast_rtx=met.tcp_fast_rtx + frx.sum(dtype=jnp.int64)))
+    st = tcp_flush(st, ctx, new_ack | frx, ds, now)
+
+    # ---- payload (in-order only: Go-Back-N receiver) and FIN
+    r = Sock(st.model.tcp, ds, mask)
+    state2 = r.g("st")
+    can_rcv = v & _state_in(state2, _RCV_STATES)
+    has_data = can_rcv & (length > 0)
+    in_order = has_data & (seq == r.g("rcv_nxt"))
+    r.s("rcv_nxt", r.g("rcv_nxt") + length, in_order)
+    nf = _notify(nf, in_order, ds, N_DATA, dlen=length)
+    msg = in_order & (mend != 0)
+    nf = _notify(nf, msg, ds, N_MSG, meta=mmeta)
+    # FIN: in order once preceding data (if any) is consumed.
+    fin_here = v & is_fin & ((seq + length) == r.g("rcv_nxt")) & _state_in(
+        state2, (TCP_ESTABLISHED, TCP_FIN_WAIT_1, TCP_FIN_WAIT_2)
+    )
+    r.s("rcv_nxt", r.g("rcv_nxt") + 1, fin_here)
+    to_cw = fin_here & (state2 == TCP_ESTABLISHED)
+    r.s("st", TCP_CLOSE_WAIT, to_cw)
+    nf = _notify(nf, to_cw, ds, N_PEER_FIN)
+    to_closing = fin_here & (state2 == TCP_FIN_WAIT_1)
+    r.s("st", TCP_CLOSING, to_closing)
+    closed_by_fin = fin_here & (state2 == TCP_FIN_WAIT_2)
+    nf = _notify(nf, closed_by_fin, ds, N_CLOSED)
+
+    # Free fully-closed sockets (slot reuse; stale packets are dropped by the
+    # peer-match guard above).
+    freed = closed_by_ack | closed_by_fin
+    r.s("st", TCP_FREE, freed)
+    r.s("rtx_t", 0, freed)
+
+    # Immediate ACK policy: ack any data (dup-ACK for OOO), any FIN (in or
+    # out of order), and the final step of the client handshake.
+    need_ack = has_data | (v & is_fin) | est_ss
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    st = _ack_now(st, ctx, need_ack, ds, now)
+    met = st.metrics
+    st = st._replace(metrics=met._replace(
+        tcp_ooo_drops=met.tcp_ooo_drops + (has_data & ~in_order).sum(dtype=jnp.int64)))
+    return st, nf
+
+
+# --------------------------------------------------------------------------
+# Timer + TX-resume event handlers
+# --------------------------------------------------------------------------
+def on_tcp_timer(st, ctx, ev):
+    """K_TCP_TIMER: lazy single-event-per-socket retransmit timer.
+
+    The event is a *check*: if the deadline moved into the future (ACKs
+    restarted it) the event re-arms itself at the new deadline; if the
+    deadline is gone it dies; else → RTO: multiplicative backoff, cwnd to
+    one segment, Go-Back-N rewind, retransmit (tcp.c retransmit timer).
+    """
+    pr = ctx.params
+    m = ev.mask & (ev.kind == K_TCP_TIMER)
+    sock = ev.p[:, 0]
+    now = ev.time
+    r = Sock(st.model.tcp, sock, m)
+    r.s("timer_armed", False, m)
+    deadline = r.g("rtx_t")
+    live = m & (deadline != 0)
+    future = live & (now < deadline)
+    r.s("timer_armed", True, future)
+    fire = live & ~future
+    outstanding = (r.g("snd_nxt") - r.g("snd_una")) > 0
+    rto_fire = fire & outstanding & _state_in(r.g("st"), _SENDABLE)
+    flight = r.g("snd_nxt") - r.g("snd_una")
+    r.s("ssthresh", jnp.maximum(flight // 2, 2 * pr.mss), rto_fire)
+    r.s("cwnd", pr.mss, rto_fire)
+    rto_n = jnp.minimum(r.g("rto") * 2, pr.rto_max)
+    r.s("rto", rto_n, rto_fire)
+    r.s("snd_nxt", r.g("snd_una"), rto_fire)
+    r.s("ts_act", False, rto_fire)
+    r.s("dupacks", 0, rto_fire)
+    r.s("recover", r.g("snd_una"), rto_fire)
+    r.s("rtx_t", now + rto_n, rto_fire)
+    r.s("timer_armed", True, rto_fire)
+    r.s("rtx_t", 0, fire & ~rto_fire)
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    met = st.metrics
+    st = st._replace(metrics=met._replace(
+        tcp_rto=met.tcp_rto + rto_fire.sum(dtype=jnp.int64)))
+    # One pending event per socket: re-push at whichever deadline applies.
+    repush = future | rto_fire
+    t_ev = jnp.where(future, deadline, now + rto_n)
+    st = _push_local(st, ctx, repush, t_ev, K_TCP_TIMER, p0=sock)
+    return tcp_flush(st, ctx, rto_fire, sock, now)
+
+
+def on_tx_resume(st, ctx, ev):
+    """K_TX_RESUME: continue a burst- or outbox-bounded flush."""
+    m = ev.mask & (ev.kind == K_TX_RESUME)
+    sock = ev.p[:, 0]
+    r = Sock(st.model.tcp, sock, m)
+    r.s("txr", 0, m)
+    st = st._replace(model=st.model._replace(tcp=r.d))
+    return tcp_flush(st, ctx, m, sock, ev.time)

@@ -22,3 +22,8 @@ import jax  # noqa: E402
 # The environment pre-sets JAX_PLATFORMS=axon (the TPU plugin) in a way that
 # wins over os.environ mutation; the config route reliably forces CPU.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the TCP round body is a large program; caching
+# compiles across test runs cuts suite time substantially.
+jax.config.update("jax_compilation_cache_dir", "/tmp/shadow1_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,115 @@
+"""Net-stack parity: NIC + TCP/UDP + apps, batched engine vs CPU oracle.
+
+The 2-host file transfer is BASELINE ladder rung 1 (the reference's minimal
+tgen example, resource/examples/). Parity must be exact: same packets, same
+byte counts, same retransmit counters, same completion times.
+"""
+
+import numpy as np
+import pytest
+
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, SEC, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+
+PARITY_KEYS = [
+    "events", "pkts_sent", "pkts_delivered", "pkts_lost",
+    "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+]
+
+
+def run_both(exp, params=None):
+    params = params or EngineParams()
+    cpu = CpuEngine(exp, params)
+    cm = cpu.run()
+    cs = cpu.summary()
+    eng = Engine(exp, params)
+    st = eng.run()
+    tm = Engine.metrics_dict(st)
+    ts = eng.model_summary(st)
+    return cm, cs, tm, ts
+
+
+def assert_parity(cm, cs, tm, ts, keys=("rx_bytes", "flows_done", "done_time")):
+    assert tm["ev_overflow"] == 0 and tm["ob_overflow"] == 0
+    assert tm["round_cap_hits"] == 0
+    for k in PARITY_KEYS:
+        assert tm[k] == cm[k], (k, tm[k], cm[k])
+    for k in keys:
+        np.testing.assert_array_equal(np.asarray(ts[k]), np.asarray(cs[k]), err_msg=k)
+
+
+def filexfer_exp(n_hosts=2, seed=11, loss=0.0, flow=100_000, end=20 * SEC, bw=10**7):
+    role = np.full(n_hosts, 1, np.int64)
+    role[0] = 0
+    server = np.zeros(n_hosts, np.int64)
+    return single_vertex_experiment(
+        n_hosts=n_hosts,
+        seed=seed,
+        end_time=end,
+        latency_ns=10 * MS,
+        loss=loss,
+        bw_bits=bw,
+        model="net",
+        model_cfg={
+            "app": "filexfer",
+            "role": role,
+            "server": server,
+            "flow_bytes": np.full(n_hosts, flow, np.int64),
+            "start_time": np.full(n_hosts, 1 * MS, np.int64),
+            "flow_count": np.where(role == 1, 1, 0),
+        },
+    )
+
+
+def test_two_host_transfer_completes_and_parity():
+    exp = filexfer_exp()
+    cm, cs, tm, ts = run_both(exp)
+    assert int(ts["total_flows_done"]) == 1
+    assert int(ts["total_rx_bytes"]) == 100_000
+    assert_parity(cm, cs, tm, ts)
+
+
+def test_transfer_under_loss_parity():
+    exp = filexfer_exp(seed=5, loss=0.02, flow=150_000, end=60 * SEC)
+    cm, cs, tm, ts = run_both(exp)
+    assert int(ts["total_flows_done"]) == 1
+    assert int(ts["total_rx_bytes"]) == 150_000
+    assert tm["tcp_rto"] + tm["tcp_fast_rtx"] > 0  # loss actually exercised recovery
+    assert_parity(cm, cs, tm, ts)
+
+
+def test_multi_client_multi_flow_parity():
+    exp = filexfer_exp(n_hosts=5, seed=3, flow=40_000, end=30 * SEC)
+    exp.model_cfg["flow_count"] = np.where(np.arange(5) >= 1, 2, 0)
+    # 4 concurrent senders into one server: size the event buffer for the
+    # aggregate in-flight packet count (the provisioning knob, SEMANTICS.md).
+    cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=256))
+    assert int(ts["total_flows_done"]) == 8  # 4 clients x 2 flows
+    assert int(ts["total_rx_bytes"]) == 8 * 40_000
+    assert_parity(cm, cs, tm, ts)
+
+
+def test_dgram_parity():
+    n = 8
+    exp = single_vertex_experiment(
+        n_hosts=n,
+        seed=9,
+        end_time=3 * SEC,
+        latency_ns=5 * MS,
+        loss=0.1,
+        model="net",
+        model_cfg={
+            "app": "dgram",
+            "dst": (np.arange(n) + 1) % n,
+            "payload": np.full(n, 500, np.int64),
+            "interval": np.full(n, 20 * MS, np.int64),
+            "count": np.full(n, 50, np.int64),
+            "start_time": np.zeros(n, np.int64),
+        },
+    )
+    cm, cs, tm, ts = run_both(exp)
+    assert int(ts["total_rx"]) > 0
+    assert tm["pkts_lost"] > 0
+    assert_parity(cm, cs, tm, ts, keys=("rx_count", "rx_bytes"))
