@@ -1,0 +1,88 @@
+"""North-star benchmark: batched-engine event throughput vs the CPU oracle.
+
+Runs the PHOLD engine-stress workload (SURVEY §4 — the reference's scheduler
+benchmark, src/test/phold/) on the batched TPU engine and on the sequential
+CPU reference engine, and prints ONE JSON line:
+
+    {"metric": "phold_events_per_sec", "value": N, "unit": "events/s",
+     "vs_baseline": tpu_events_per_sec / cpu_engine_events_per_sec, ...}
+
+The CPU comparator here is this repo's own reference engine (BASELINE.md:
+no external numbers exist in-environment); the native thread-per-core
+comparator lands with the C++ engine milestone.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import shadow1_tpu  # noqa: F401  (x64 on, before jax arrays exist)
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from shadow1_tpu.config.compiled import single_vertex_experiment
+    from shadow1_tpu.consts import MS, SEC, EngineParams
+    from shadow1_tpu.core.engine import Engine
+    from shadow1_tpu.cpu_engine import CpuEngine
+
+    n_hosts = 4096
+    mean_delay = 2 * MS
+    window = 1 * MS
+    sim_seconds = 2
+    exp = single_vertex_experiment(
+        n_hosts=n_hosts,
+        seed=1234,
+        end_time=sim_seconds * SEC,
+        latency_ns=window,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(mean_delay), "init_events": 2},
+    )
+    params = EngineParams(ev_cap=32, outbox_cap=32, max_rounds=64)
+
+    eng = Engine(exp, params)
+    # Warm-up at the FULL window count: n_windows is a jit static arg, so the
+    # timed call below must reuse this exact compiled program.
+    st = eng.run()
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    st = eng.run()
+    jax.block_until_ready(st)
+    tpu_wall = time.perf_counter() - t0
+    m = Engine.metrics_dict(st)
+    tpu_eps = m["events"] / tpu_wall
+
+    # CPU oracle on a slice of the sim (it is >10x slower; extrapolating
+    # events/sec from 10% of the windows is fair — PHOLD is stationary).
+    cpu = CpuEngine(exp, params)
+    cpu_windows = max(1, eng.n_windows // 10)
+    t0 = time.perf_counter()
+    cm = cpu.run(n_windows=cpu_windows)
+    cpu_wall = time.perf_counter() - t0
+    cpu_eps = cm["events"] / cpu_wall
+
+    sim_per_wall = (eng.n_windows * exp.window / SEC) / tpu_wall
+    print(json.dumps({
+        "metric": "phold_events_per_sec",
+        "value": round(tpu_eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(tpu_eps / cpu_eps, 3),
+        "detail": {
+            "n_hosts": n_hosts,
+            "events": m["events"],
+            "tpu_wall_s": round(tpu_wall, 3),
+            "sim_sec_per_wall_sec": round(sim_per_wall, 3),
+            "cpu_engine_events_per_sec": round(cpu_eps, 1),
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "ev_overflow": m["ev_overflow"],
+            "ob_overflow": m["ob_overflow"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

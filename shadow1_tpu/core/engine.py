@@ -71,22 +71,34 @@ class SimState(NamedTuple):
 
 @dataclasses.dataclass(frozen=True)
 class Ctx:
-    """Trace-time context handed to model handler builders."""
+    """Trace-time context handed to model handler builders.
 
-    n_hosts: int
+    Shard-awareness: the engine state lives on a (possibly sharded) host
+    axis. ``n_hosts`` is the LOCAL block size (every [H, ...] tensor shape),
+    ``hosts`` holds the GLOBAL host ids of this block (a contiguous range),
+    and ``n_total`` is the global host count. Anything semantic — RNG
+    streams keyed by host, packet src/dst fields, random destination draws —
+    uses global ids; anything shape-like uses ``n_hosts``. On a single
+    device the two views coincide (hosts == arange(n_hosts)).
+    """
+
+    n_hosts: int            # local host-axis block size
+    n_total: int            # global host count
     params: EngineParams
     window: int
     key: jax.Array          # base PRNG key (device)
     lat_vv: jax.Array       # i64 [V, V]
     loss_vv: jax.Array      # f32 [V, V]
-    host_vertex: jax.Array  # i32 [H]
-    bw_up: jax.Array        # i64 [H]
-    bw_dn: jax.Array        # i64 [H]
+    host_vertex: jax.Array  # i32 [n_total] — indexed by GLOBAL host id
+    bw_up: jax.Array        # i64 [H] local
+    bw_dn: jax.Array        # i64 [H] local
     model_cfg: dict
+    hosts: jax.Array = None  # i32 [H] global host ids of this block
 
-    @property
-    def hosts(self) -> jax.Array:
-        return jnp.arange(self.n_hosts, dtype=jnp.int32)
+    def __post_init__(self):
+        if self.hosts is None:
+            # Single-device default: the block IS the whole host range.
+            object.__setattr__(self, "hosts", jnp.arange(self.n_hosts, dtype=jnp.int32))
 
 
 Handler = Callable[[SimState, Popped], SimState]
@@ -111,6 +123,144 @@ def push_local_event(st: SimState, ctx: Ctx, mask, time, kind, p0=None, p1=None)
     return st._replace(
         evbuf=evbuf,
         metrics=m._replace(ev_overflow=m.ev_overflow + over.sum(dtype=jnp.int64)),
+    )
+
+
+# --------------------------------------------------------------------------
+# Window-step building blocks, shared by the single-device Engine and the
+# sharded engine (shard/engine.py). All take the local-block view: state
+# tensors sized [ctx.n_hosts, ...], global host ids in ctx.hosts.
+# --------------------------------------------------------------------------
+
+class FlatPackets(NamedTuple):
+    """One window's routed packets, flattened to a single axis.
+
+    ``dst`` is a GLOBAL host id; ``keep`` marks packets that survived the
+    loss draw. Under sharding, each shard produces its local FlatPackets and
+    the per-window all_gather over the mesh concatenates them (shard-major =
+    global host-major, the exact order the single-device engine uses).
+    """
+
+    dst: jnp.ndarray      # i32 [N] global dst host
+    arrival: jnp.ndarray  # i64 [N]
+    tb: jnp.ndarray       # i64 [N]
+    kind: jnp.ndarray     # i32 [N]
+    p: jnp.ndarray        # i32 [N, NP]
+    keep: jnp.ndarray     # bool [N]
+
+
+def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
+    """One inner round: per-host pop-min + every handler's masked pass."""
+    evbuf, ev = pop_until(st.evbuf, win_end)
+    m = st.metrics
+    st = st._replace(
+        evbuf=evbuf,
+        metrics=m._replace(
+            events=m.events + ev.mask.sum(dtype=jnp.int64),
+            rounds=m.rounds + 1,
+        ),
+    )
+    for _kind, fn in sorted(handlers.items()):
+        st = fn(st, ev)
+    return st
+
+
+def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.ndarray]:
+    """Route this block's outbox: latency gather + loss draws (src side).
+
+    The tensor analogue of the reference's topology path lookup at send time
+    (src/main/routing/topology.c getLatency/getReliability, SURVEY §3.3).
+    Returns (flat_packets, n_sent, n_lost)."""
+    h, cap = ob.dst.shape
+    mask = jnp.arange(cap)[None, :] < ob.cnt[:, None]
+    src = jnp.broadcast_to(ctx.hosts[:, None], (h, cap))
+
+    def flat(x):
+        return x.reshape((h * cap,) + x.shape[2:])
+
+    fmask, fsrc, fdst = flat(mask), flat(src), flat(ob.dst)
+    fdst_safe = jnp.where(fmask, fdst, 0)
+    vs = ctx.host_vertex[fsrc]
+    vd = ctx.host_vertex[fdst_safe]
+    arrival = flat(ob.depart) + ctx.lat_vv[vs, vd]
+    bits = rng.bits_v(ctx.key, R_LOSS, fsrc, flat(ob.ctr))
+    lost = fmask & (rng.uniform01(bits) < ctx.loss_vv[vs, vd])
+    keep = fmask & ~lost
+    tb = packet_tb(fsrc.astype(jnp.int64), flat(ob.ctr))
+    fp = FlatPackets(
+        dst=fdst_safe, arrival=arrival, tb=tb, kind=flat(ob.kind), p=flat(ob.p),
+        keep=keep,
+    )
+    return fp, fmask.sum(dtype=jnp.int64), lost.sum(dtype=jnp.int64)
+
+
+def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
+    """Scatter (possibly gathered) packets into this block's event buffers.
+
+    Maps global dst ids onto the local block (contiguous range starting at
+    ctx.hosts[0]); packets for other blocks are masked out. Returns
+    (evbuf, n_delivered, n_overflow) counting only this block's packets."""
+    base = ctx.hosts[0].astype(fp.dst.dtype)
+    local = fp.dst - base
+    mine = fp.keep & (local >= 0) & (local < ctx.n_hosts)
+    local = jnp.where(mine, local, 0)
+    evbuf, n_over = deliver_batch(
+        evbuf, local, fp.arrival, fp.tb, fp.kind, fp.p, mine
+    )
+    return evbuf, mine.sum(dtype=jnp.int64) - n_over, n_over
+
+
+def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
+    """Window-end packet exchange: route, (all_gather under sharding), scatter.
+
+    ``exchange`` maps FlatPackets → FlatPackets across the mesh (identity on
+    a single device; a tiled all_gather over the host axis when sharded —
+    the one collective per window, SURVEY §2.5)."""
+    fp, n_sent, n_lost = route_outbox(ctx, st.outbox)
+    if exchange is not None:
+        fp = exchange(fp)
+    evbuf, n_deliv, n_over = deliver_flat(st.evbuf, ctx, fp)
+    m = st.metrics
+    return st._replace(
+        evbuf=evbuf,
+        outbox=outbox_clear(st.outbox),
+        metrics=m._replace(
+            pkts_sent=m.pkts_sent + n_sent,
+            pkts_delivered=m.pkts_delivered + n_deliv,
+            pkts_lost=m.pkts_lost + n_lost,
+            ev_overflow=m.ev_overflow + n_over,
+        ),
+    )
+
+
+def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None) -> SimState:
+    """One conservative window: inner rounds to quiescence, then delivery.
+
+    The batched form of the reference's barrier round
+    (scheduler_continueNextRound in src/main/core/scheduler/scheduler.c):
+    the while_loop plays the worker event loop, the delivery plays the
+    cross-thread event push that the barrier makes safe."""
+    win_end = st.win_start + ctx.window
+    max_rounds = ctx.params.max_rounds
+
+    def cond(carry):
+        s, r = carry
+        return (r < max_rounds) & any_eligible(s.evbuf, win_end)
+
+    def body(carry):
+        s, r = carry
+        return run_round(s, ctx, handlers, win_end), r + 1
+
+    st, r = jax.lax.while_loop(cond, body, (st, jnp.zeros((), jnp.int32)))
+    cap_hit = (r >= max_rounds) & any_eligible(st.evbuf, win_end)
+    st = deliver_window(st, ctx, exchange)
+    m = st.metrics
+    return st._replace(
+        win_start=win_end,
+        metrics=m._replace(
+            windows=m.windows + 1,
+            round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
+        ),
     )
 
 
@@ -141,6 +291,7 @@ class Engine:
         self.n_windows = int(-(-exp.end_time // self.window))
         self.ctx = Ctx(
             n_hosts=exp.n_hosts,
+            n_total=exp.n_hosts,
             params=self.params,
             window=self.window,
             key=rng.base_key(exp.seed),
@@ -172,79 +323,8 @@ class Engine:
         )
 
     # -- window step pieces ----------------------------------------------
-    def _round(self, st: SimState, win_end) -> SimState:
-        evbuf, ev = pop_until(st.evbuf, win_end)
-        m = st.metrics
-        st = st._replace(
-            evbuf=evbuf,
-            metrics=m._replace(
-                events=m.events + ev.mask.sum(dtype=jnp.int64),
-                rounds=m.rounds + 1,
-            ),
-        )
-        for _kind, fn in sorted(self._handlers.items()):
-            st = fn(st, ev)
-        return st
-
-    def _deliver(self, st: SimState) -> SimState:
-        """Window-end routing + scatter of all outbox packets."""
-        ctx, ob = self.ctx, st.outbox
-        h, cap = ob.dst.shape
-        mask = (jnp.arange(cap)[None, :] < ob.cnt[:, None])
-        src = jnp.broadcast_to(jnp.arange(h, dtype=jnp.int32)[:, None], (h, cap))
-
-        def flat(x):
-            return x.reshape((h * cap,) + x.shape[2:])
-
-        fmask, fsrc, fdst = flat(mask), flat(src), flat(ob.dst)
-        fdst_safe = jnp.where(fmask, fdst, 0)
-        vs = ctx.host_vertex[fsrc]
-        vd = ctx.host_vertex[fdst_safe]
-        lat = ctx.lat_vv[vs, vd]
-        arrival = flat(ob.depart) + lat
-        loss_p = ctx.loss_vv[vs, vd]
-        bits = rng.bits_v(ctx.key, R_LOSS, fsrc, flat(ob.ctr))
-        lost = fmask & (rng.uniform01(bits) < loss_p)
-        keep = fmask & ~lost
-        tb = packet_tb(fsrc.astype(jnp.int64), flat(ob.ctr))
-        evbuf, n_over = deliver_batch(
-            st.evbuf, fdst_safe, arrival, tb, flat(ob.kind), flat(ob.p), keep
-        )
-        m = st.metrics
-        return st._replace(
-            evbuf=evbuf,
-            outbox=outbox_clear(ob),
-            metrics=m._replace(
-                pkts_sent=m.pkts_sent + fmask.sum(dtype=jnp.int64),
-                pkts_delivered=m.pkts_delivered + keep.sum(dtype=jnp.int64) - n_over,
-                pkts_lost=m.pkts_lost + lost.sum(dtype=jnp.int64),
-                ev_overflow=m.ev_overflow + n_over,
-            ),
-        )
-
     def _window_step(self, st: SimState) -> SimState:
-        win_end = st.win_start + self.window
-        max_rounds = self.params.max_rounds
-
-        def cond(carry):
-            s, r = carry
-            return (r < max_rounds) & any_eligible(s.evbuf, win_end)
-
-        def body(carry):
-            s, r = carry
-            return self._round(s, win_end), r + 1
-
-        st, r = jax.lax.while_loop(cond, body, (st, jnp.zeros((), jnp.int32)))
-        cap_hit = (r >= max_rounds) & any_eligible(st.evbuf, win_end)
-        st = self._deliver(st)
-        m = st.metrics
-        return st._replace(
-            win_start=win_end,
-            metrics=m._replace(
-                windows=m.windows + 1,
-                round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
-            ),
-        )
+        return window_step(st, self.ctx, self._handlers)
 
     def _make_run(self):
         def run(st: SimState, n_windows: int) -> SimState:

@@ -54,7 +54,7 @@ def make_handlers(ctx):
         delay = rng.exponential_ns(
             rng.bits_v(ctx.key, R_PHOLD_DELAY, hosts, model.ctr), mean
         )
-        dst = rng.randint(rng.bits_v(ctx.key, R_PHOLD_DST, hosts, model.ctr), ctx.n_hosts)
+        dst = rng.randint(rng.bits_v(ctx.key, R_PHOLD_DST, hosts, model.ctr), ctx.n_total)
         t_next = ev.time + delay
         zero_p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
         k = jnp.full(ctx.n_hosts, K_PHOLD, jnp.int32)

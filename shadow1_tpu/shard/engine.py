@@ -1,0 +1,196 @@
+"""Multi-device engine: the host axis sharded over a JAX mesh.
+
+The reference scales by partitioning hosts across worker threads
+(src/main/core/scheduler/scheduler-policy-host-steal.c et al., SURVEY §2.5);
+the TPU-native equivalent shards the host axis of every state tensor over a
+``jax.sharding.Mesh`` with ``jax.shard_map``. Inside a window each device
+runs its local block's rounds completely independently (the conservative
+lookahead guarantees no mid-window cross-host interaction — the same
+invariant the reference's barrier rounds rely on); at the window end the
+routed packet batch is exchanged with ONE tiled ``all_gather`` over the mesh
+axis and each shard scatters the packets addressed to its hosts. That single
+collective per window is the entire communication schedule — it rides ICI
+within a slice and DCN across slices, replacing the reference's locked
+cross-thread event push (src/main/utility/async-priority-queue.c).
+
+Determinism across shardings: the gathered packet order is shard-major ×
+host-major = global host-major — exactly the single-device flatten order —
+and all event/tie-break keys are computed from global host ids, so the
+delivered event streams are identical for any device count. The
+``rounds``/``round_cap_hits`` metrics are the one exception (each shard
+counts its own inner rounds; they are summed), so they are performance
+counters, not semantic invariants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from shadow1_tpu import rng
+from shadow1_tpu.config.compiled import CompiledExperiment
+from shadow1_tpu.consts import EngineParams
+from shadow1_tpu.core.engine import (
+    Ctx,
+    Engine,
+    SimState,
+    _metrics_init,
+    _model_module,
+    window_step,
+)
+from shadow1_tpu.core.events import evbuf_init
+from shadow1_tpu.core.outbox import outbox_init
+
+
+class ShardedEngine:
+    """Engine running one CompiledExperiment over an n-device host-axis mesh.
+
+    API mirrors core.engine.Engine: init_state() → run() → metrics_dict /
+    model_summary. n_hosts must divide evenly by the device count.
+    """
+
+    def __init__(
+        self,
+        exp: CompiledExperiment,
+        params: EngineParams | None = None,
+        devices=None,
+        axis: str = "hosts",
+    ):
+        exp.validate()
+        self.exp = exp
+        self.params = params or EngineParams()
+        devices = list(devices if devices is not None else jax.devices())
+        self.n_dev = len(devices)
+        if exp.n_hosts % self.n_dev:
+            raise ValueError(
+                f"n_hosts={exp.n_hosts} not divisible by {self.n_dev} devices"
+            )
+        self.h_local = exp.n_hosts // self.n_dev
+        self.axis = axis
+        self.mesh = jax.make_mesh((self.n_dev,), (axis,), devices=devices)
+        self.window = exp.window
+        self.n_windows = int(-(-exp.end_time // self.window))
+        # Global-view ctx: used for state init (which runs unsharded) and for
+        # model summaries. Semantically identical to the single-device ctx.
+        self.global_ctx = Ctx(
+            n_hosts=exp.n_hosts,
+            n_total=exp.n_hosts,
+            params=self.params,
+            window=self.window,
+            key=rng.base_key(exp.seed),
+            lat_vv=jnp.asarray(exp.lat_vv, jnp.int64),
+            loss_vv=jnp.asarray(exp.loss_vv, jnp.float32),
+            host_vertex=jnp.asarray(exp.host_vertex, jnp.int32),
+            bw_up=jnp.asarray(exp.bw_up, jnp.int64),
+            bw_dn=jnp.asarray(exp.bw_dn, jnp.int64),
+            model_cfg=exp.model_cfg,
+        )
+        self._model = _model_module(exp.model)
+        self._run_jit = jax.jit(self._make_run(), static_argnums=1)
+
+    # -- sharding specs ----------------------------------------------------
+    def _spec_for(self, leaf) -> P:
+        # Every rank≥1 state tensor is host-major by design; scalars are
+        # replicated. (Guarded by the n_hosts match so aux leaves of other
+        # shapes would fail loudly in shard_map rather than mis-shard.)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == self.exp.n_hosts:
+            return P(self.axis)
+        return P()
+
+    def _state_specs(self, st: SimState):
+        return jax.tree.map(self._spec_for, st)
+
+    # -- state -------------------------------------------------------------
+    def init_state(self) -> SimState:
+        evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
+        model, evbuf, seed_over = self._model.init(self.global_ctx, evbuf)
+        metrics = _metrics_init()
+        st = SimState(
+            win_start=jnp.zeros((), jnp.int64),
+            evbuf=evbuf,
+            outbox=outbox_init(self.exp.n_hosts, self.params.outbox_cap),
+            model=model,
+            metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
+        )
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self._state_specs(st)
+        )
+        return jax.device_put(st, shardings)
+
+    # -- the sharded program ----------------------------------------------
+    def _make_run(self):
+        exp, pr, axis = self.exp, self.params, self.axis
+        n_dev, h_local = self.n_dev, self.h_local
+        window, model = self.window, self._model
+        key = self.global_ctx.key
+        lat_vv = self.global_ctx.lat_vv
+        loss_vv = self.global_ctx.loss_vv
+        host_vertex = self.global_ctx.host_vertex  # full, replicated
+        hosts_g = self.global_ctx.hosts
+        bw_up_g = self.global_ctx.bw_up
+        bw_dn_g = self.global_ctx.bw_dn
+
+        def block(st: SimState, hosts, bw_up, bw_dn, n_windows: int) -> SimState:
+            ctx = Ctx(
+                n_hosts=h_local,
+                n_total=exp.n_hosts,
+                params=pr,
+                window=window,
+                key=key,
+                lat_vv=lat_vv,
+                loss_vv=loss_vv,
+                host_vertex=host_vertex,
+                bw_up=bw_up,
+                bw_dn=bw_dn,
+                model_cfg=exp.model_cfg,
+                hosts=hosts,
+            )
+            handlers = model.make_handlers(ctx)
+
+            def exchange(fp):
+                # The one collective per window (SURVEY §2.5): tiled gather
+                # of every shard's routed packets, shard-major order.
+                return jax.tree.map(
+                    lambda x: jax.lax.all_gather(x, axis, tiled=True), fp
+                )
+
+            init_metrics = st.metrics
+            st = jax.lax.fori_loop(
+                0, n_windows, lambda _, s: window_step(s, ctx, handlers, exchange), st
+            )
+            # Each shard accumulated its own partials on top of the (replicated)
+            # input metrics; psum then re-subtract the duplicated baseline.
+            mfin = jax.tree.map(
+                lambda f, i: jax.lax.psum(f, axis) - (n_dev - 1) * i,
+                st.metrics,
+                init_metrics,
+            )
+            # ``windows`` advances identically on every shard (replicated, like
+            # win_start) — keep the local count rather than the 8× sum.
+            return st._replace(metrics=mfin._replace(windows=st.metrics.windows))
+
+        def run(st: SimState, n_windows: int) -> SimState:
+            specs = self._state_specs(st)
+            f = jax.shard_map(
+                lambda s, h, bu, bd: block(s, h, bu, bd, n_windows),
+                mesh=self.mesh,
+                in_specs=(specs, P(axis), P(axis), P(axis)),
+                out_specs=specs,
+                check_vma=False,
+            )
+            return f(st, hosts_g, bw_up_g, bw_dn_g)
+
+        return run
+
+    # -- public ------------------------------------------------------------
+    def run(self, st: SimState | None = None, n_windows: int | None = None) -> SimState:
+        if st is None:
+            st = self.init_state()
+        return self._run_jit(st, n_windows if n_windows is not None else self.n_windows)
+
+    metrics_dict = staticmethod(Engine.metrics_dict)
+
+    def model_summary(self, st: SimState):
+        return jax.tree.map(np.asarray, self._model.summary(st.model, self.global_ctx))

@@ -1,0 +1,83 @@
+"""Sharding parity: the 8-device host-axis mesh vs the single-device engine.
+
+Determinism across shardings is a hard invariant inherited from the
+reference ("same config ⇒ same results regardless of worker count",
+SURVEY §4): every semantic metric and model summary must be bit-identical
+between the single-device engine and the shard_map engine on the virtual
+8-device CPU mesh. Round counters are excluded — each shard runs its own
+inner round loop, so their sum legitimately differs from the global count.
+"""
+
+import numpy as np
+
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, SEC, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.shard.engine import ShardedEngine
+
+SEMANTIC_KEYS = [
+    "events", "windows", "pkts_sent", "pkts_delivered", "pkts_lost",
+    "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+]
+
+
+def run_pair(exp, params=None):
+    params = params or EngineParams()
+    eng = Engine(exp, params)
+    st1 = eng.run()
+    sh = ShardedEngine(exp, params)
+    assert sh.n_dev == 8, "conftest must provide 8 virtual devices"
+    st8 = sh.run()
+    return (
+        Engine.metrics_dict(st1),
+        eng.model_summary(st1),
+        ShardedEngine.metrics_dict(st8),
+        sh.model_summary(st8),
+    )
+
+
+def assert_same(m1, s1, m8, s8, summary_keys):
+    for k in SEMANTIC_KEYS:
+        assert m8[k] == m1[k], (k, m8[k], m1[k])
+    for k in summary_keys:
+        np.testing.assert_array_equal(np.asarray(s8[k]), np.asarray(s1[k]), err_msg=k)
+
+
+def test_phold_sharded_parity():
+    exp = single_vertex_experiment(
+        n_hosts=64,
+        seed=7,
+        end_time=50 * MS,
+        latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 2},
+    )
+    m1, s1, m8, s8 = run_pair(exp)
+    assert m1["events"] > 500  # the workload actually ran
+    assert_same(m1, s1, m8, s8, summary_keys=("hops",))
+
+
+def test_filexfer_sharded_parity():
+    n = 8
+    role = np.full(n, 1, np.int64)
+    role[0] = 0
+    exp = single_vertex_experiment(
+        n_hosts=n,
+        seed=3,
+        end_time=20 * SEC,
+        latency_ns=10 * MS,
+        loss=0.01,
+        bw_bits=10**7,
+        model="net",
+        model_cfg={
+            "app": "filexfer",
+            "role": role,
+            "server": np.zeros(n, np.int64),
+            "flow_bytes": np.full(n, 30_000, np.int64),
+            "start_time": np.full(n, 1 * MS, np.int64),
+            "flow_count": np.where(role == 1, 1, 0),
+        },
+    )
+    m1, s1, m8, s8 = run_pair(exp, EngineParams(ev_cap=256))
+    assert int(s1["total_flows_done"]) == 7
+    assert_same(m1, s1, m8, s8, summary_keys=("rx_bytes", "flows_done", "done_time"))
