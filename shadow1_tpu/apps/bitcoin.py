@@ -1,0 +1,254 @@
+"""bitcoin — inv/getdata/tx gossip over a P2P graph (BASELINE rung 5).
+
+The model-application analogue of the reference's bitcoin plugin
+(shadow-plugin-bitcoin, SURVEY §2.4/§7.1: "Bitcoin = inv/getdata/tx gossip
+state machine"). Nodes hold persistent TCP connections along the edges of a
+configured peer graph; a transaction created at its origin is announced with
+small INV messages, fetched with GETDATA, transferred as a tx-sized payload,
+and re-announced by each node on first receipt — the classic flood. The
+instrumented output is propagation: which nodes saw each tx, and when.
+
+Wire model: INV/GETDATA/TX are message boundaries on the TCP byte stream
+(meta = cmd<<20 | txid), so loss/recovery/queueing all ride the real virtual
+TCP machinery.
+
+Batched-engine shape note: fan-out (dial K neighbors, announce a tx on K
+conns) is expressed as K self-scheduled events at the same timestamp rather
+than K inline transport calls — the event core serializes them in
+deterministic (time, tb) order, the CPU oracle schedules the identical
+events, and the traced round body instantiates the TCP send path once
+instead of K times (the SIMD analogue of the reference queueing work items
+rather than deep call chains).
+
+model_cfg:
+  peers      i32 [H, K] neighbor ids, -1 = unused slot (edges must be
+             symmetric: n in peers[h] ⇔ h in peers[n])
+  tx_origin  i32 [T] origin host per transaction
+  tx_time    i64 [T] creation time per transaction (leave ≥ a few RTT after
+             connect_time so the conn mesh is up)
+  tx_size    int, payload bytes of a transaction (default 400)
+  inv_size   int, bytes of INV/GETDATA messages (default 36)
+  connect_time  int ns, when the conn mesh is dialed (default 0)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from shadow1_tpu.consts import (
+    K_APP,
+    N_ACCEPTED,
+    N_MSG,
+    NP,
+    TCP_LISTEN,
+)
+from shadow1_tpu.core.engine import push_local_event
+from shadow1_tpu.core.events import push_local
+from shadow1_tpu.tcp import tcp as T
+
+OP_CONNECT_ONE = 1   # p1 = neighbor slot j
+OP_TX_CREATE = 2     # p1 = txid
+OP_TX_MSG = 3        # p1 = socket, p2 = meta, p3 = nbytes
+
+CMD_INV = 1
+CMD_GET = 2
+CMD_TX = 3
+
+TXID_BITS = 20
+TXID_MASK = (1 << TXID_BITS) - 1
+
+
+def _meta(cmd, txid):
+    return (cmd << TXID_BITS) | txid
+
+
+def init(ctx, evbuf, tcpd):
+    cfg = ctx.model_cfg
+    peers = jnp.asarray(cfg["peers"], jnp.int32)          # [H, K]
+    tx_origin = np.asarray(cfg["tx_origin"], np.int64)    # [T] (host-side)
+    tx_time = np.asarray(cfg["tx_time"], np.int64)
+    n_tx = len(tx_origin)
+    assert n_tx <= TXID_MASK
+    h, k_max = peers.shape
+    app = {
+        "peers": peers,
+        # Socket reaching neighbor j (outbound = 1+j at dial time; inbound
+        # learned on N_ACCEPTED); -1 = no conn yet.
+        "nbr_sock": jnp.full((h, k_max), -1, jnp.int32),
+        "seen": jnp.zeros((h, n_tx), bool),
+        "req": jnp.zeros((h, n_tx), bool),
+        "seen_time": jnp.zeros((h, n_tx), jnp.int64),
+        "tx_rx": jnp.zeros(h, jnp.int64),   # tx payloads received
+        "msg_retries": jnp.zeros(h, jnp.int64),
+    }
+    tcpd = dict(tcpd)
+    tcpd["st"] = tcpd["st"].at[:, 0].set(TCP_LISTEN)
+    # Dial the conn mesh: one OP_CONNECT_ONE per outbound neighbor slot.
+    connect_time = jnp.full(ctx.n_hosts, int(cfg.get("connect_time", 0)), jnp.int64)
+    kk = jnp.full(ctx.n_hosts, K_APP, jnp.int32)
+    n_over = jnp.zeros((), jnp.int64)
+    for j in range(k_max):
+        m = peers[:, j] > ctx.hosts
+        p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+        p = p.at[:, 0].set(OP_CONNECT_ONE).at[:, 1].set(j)
+        evbuf, over = push_local(evbuf, m, connect_time, kk, p)
+        n_over = n_over + over.sum(dtype=jnp.int64)
+    # Seed tx-creation wakeups, one masked push per transaction.
+    for t in range(n_tx):
+        mask = ctx.hosts == int(tx_origin[t])
+        p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+        p = p.at[:, 0].set(OP_TX_CREATE).at[:, 1].set(t)
+        evbuf, over = push_local(
+            evbuf, mask, jnp.full(ctx.n_hosts, int(tx_time[t]), jnp.int64), kk, p
+        )
+        n_over = n_over + over.sum(dtype=jnp.int64)
+    return app, evbuf, n_over, tcpd
+
+
+def _push_msg(st, ctx, mask, sock, meta, nbytes, now):
+    """Queue a protocol message send (admission-checked in OP_TX_MSG)."""
+    return push_local_event(
+        st, ctx, mask, now, K_APP, p0=OP_TX_MSG, p1=sock, p2=meta, p3=nbytes
+    )
+
+
+def _announce(st, ctx, mask, txid, skip_sock, now):
+    """Queue one INV per live neighbor conn except ``skip_sock``."""
+    inv_size = int(ctx.model_cfg.get("inv_size", 36))
+    app = st.model.app
+    for j in range(app["peers"].shape[1]):
+        ns = app["nbr_sock"][:, j]
+        m = mask & (ns >= 0) & (ns != skip_sock)
+        st = _push_msg(st, ctx, m, ns, _meta(CMD_INV, txid), inv_size, now)
+    return st
+
+
+def _mark_seen(app, mask, txid, now):
+    hh = jnp.arange(app["seen"].shape[0])
+    t_safe = jnp.where(mask, txid, 0)
+    was = app["seen"][hh, t_safe]
+    new = mask & ~was
+    tcol = jnp.where(new, t_safe, app["seen"].shape[1])
+    app["seen"] = app["seen"].at[hh, tcol].set(True, mode="drop")
+    app["seen_time"] = app["seen_time"].at[hh, tcol].set(now, mode="drop")
+    return app, new
+
+
+def on_wakeup(st, ctx, ev, mask):
+    op = ev.p[:, 0]
+    app = st.model.app
+    k_max = app["peers"].shape[1]
+    hh = jnp.arange(ctx.n_hosts)
+    zero = jnp.zeros(ctx.n_hosts, jnp.int32)
+
+    # OP_CONNECT_ONE: dial neighbor slot j = p1 on socket 1+j.
+    conn = mask & (op == OP_CONNECT_ONE)
+    j = jnp.where(conn, ev.p[:, 1], 0)
+    peer = app["peers"][hh, jnp.minimum(j, k_max - 1)]
+    sock = (1 + j).astype(jnp.int32)
+    napp = dict(app)
+    napp["nbr_sock"] = napp["nbr_sock"].at[hh, jnp.where(conn, j, k_max)].set(
+        sock, mode="drop"
+    )
+    st = st._replace(model=st.model._replace(app=napp))
+    st = T.tcp_connect(st, ctx, conn, sock, peer, zero, ev.time)
+
+    # OP_TX_CREATE: origin marks the tx seen and queues the announcements.
+    create = mask & (op == OP_TX_CREATE)
+    txid = ev.p[:, 1]
+    app = dict(st.model.app)
+    app, new = _mark_seen(app, create, txid, ev.time)
+    st = st._replace(model=st.model._replace(app=app))
+    none = jnp.full(ctx.n_hosts, -1, jnp.int32)
+    st = _announce(st, ctx, new, txid, none, ev.time)
+
+    # OP_TX_MSG: the single transport-send site. Admission: the message must
+    # fit the send buffer and a boundary slot must be free, else retry at the
+    # next window start — a congested conn defers gossip instead of losing
+    # its framing (same shape as tor.py's OP_TX_CELL).
+    tx = mask & (op == OP_TX_MSG)
+    sock, meta, nbytes = ev.p[:, 1], ev.p[:, 2], ev.p[:, 3]
+    tcp = st.model.tcp
+    sk = jnp.where(tx, sock, 0)
+    snd_una = tcp["snd_una"][hh, sk]
+    app_end = tcp["app_end"][hh, sk]
+    buffered = (app_end - snd_una) - (snd_una == 0).astype(jnp.int32)
+    fits = (ctx.params.sndbuf - buffered) >= nbytes
+    mq_ok = ~tcp["mq_valid"][hh, sk].all(axis=1)
+    can = tx & fits & mq_ok
+    retry = tx & ~can
+    st, _acc = T.tcp_send(st, ctx, can, sock, nbytes, meta, ev.time)
+    napp = dict(st.model.app)
+    napp["msg_retries"] = napp["msg_retries"] + retry.astype(jnp.int64)
+    st = st._replace(model=st.model._replace(app=napp))
+    t_retry = (ev.time // ctx.window + 1) * ctx.window
+    return push_local_event(
+        st, ctx, retry, t_retry, K_APP, p0=OP_TX_MSG, p1=sock, p2=meta, p3=nbytes
+    )
+
+
+def on_notify(st, ctx, nf: T.Notif, now, mask):
+    f = nf.flags
+    sock = nf.sock
+    hh = jnp.arange(ctx.n_hosts)
+    tx_size = int(ctx.model_cfg.get("tx_size", 400))
+    inv_size = int(ctx.model_cfg.get("inv_size", 36))
+
+    # Inbound conn accepted: bind it to its neighbor slot.
+    acc = mask & ((f & N_ACCEPTED) != 0)
+    app = dict(st.model.app)
+    peer = st.model.tcp["peer_host"][hh, jnp.where(acc, sock, 0)]
+    for j in range(app["peers"].shape[1]):
+        m = acc & (app["peers"][:, j] == peer) & (app["nbr_sock"][:, j] < 0)
+        app["nbr_sock"] = app["nbr_sock"].at[:, j].set(
+            jnp.where(m, sock, app["nbr_sock"][:, j])
+        )
+    st = st._replace(model=st.model._replace(app=app))
+
+    # Protocol messages (one boundary per host-round at most).
+    msg = mask & ((f & N_MSG) != 0)
+    cmd = nf.meta >> TXID_BITS
+    txid = nf.meta & TXID_MASK
+    app = st.model.app
+    t_safe = jnp.where(msg, txid, 0)
+    seen = app["seen"][hh, t_safe]
+    req = app["req"][hh, t_safe]
+
+    # INV for an unknown tx → GETDATA back on the same conn.
+    want = msg & (cmd == CMD_INV) & ~seen & ~req
+    napp = dict(app)
+    tcol = jnp.where(want, t_safe, napp["req"].shape[1])
+    napp["req"] = napp["req"].at[hh, tcol].set(True, mode="drop")
+    st = st._replace(model=st.model._replace(app=napp))
+
+    # GETDATA for a tx we hold → send the payload. The two responses are
+    # mutually exclusive per host-round, so they share one queued send.
+    give = msg & (cmd == CMD_GET) & seen
+    resp = want | give
+    nbytes = jnp.where(give, tx_size, inv_size).astype(jnp.int32)
+    rmeta = jnp.where(
+        give, _meta(CMD_TX, txid), _meta(CMD_GET, jnp.where(msg, txid, 0))
+    )
+    st = _push_msg(st, ctx, resp, sock, rmeta, nbytes, now)
+
+    # TX payload → first sight: record + queue announcements everywhere else.
+    got = msg & (cmd == CMD_TX)
+    app = dict(st.model.app)
+    app["tx_rx"] = app["tx_rx"] + got.astype(jnp.int64)
+    app, new = _mark_seen(app, got, txid, now)
+    st = st._replace(model=st.model._replace(app=app))
+    return _announce(st, ctx, new, txid, sock, now)
+
+
+def summary(app) -> dict:
+    seen = app["seen"]
+    return {
+        "seen": seen,
+        "seen_time": app["seen_time"],
+        "tx_rx": app["tx_rx"],
+        "reach": seen.sum(axis=0),            # nodes reached per tx
+        "msg_retries": app["msg_retries"],
+        "total_seen": seen.sum(),
+        "total_tx_rx": app["tx_rx"].sum(),
+    }

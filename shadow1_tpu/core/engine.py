@@ -104,7 +104,8 @@ class Ctx:
 Handler = Callable[[SimState, Popped], SimState]
 
 
-def push_local_event(st: SimState, ctx: Ctx, mask, time, kind, p0=None, p1=None) -> SimState:
+def push_local_event(st: SimState, ctx: Ctx, mask, time, kind,
+                     p0=None, p1=None, p2=None, p3=None) -> SimState:
     """Push one local event per host where ``mask``, counting overflow.
 
     The engine-state-level convenience over events.push_local used by all
@@ -113,10 +114,9 @@ def push_local_event(st: SimState, ctx: Ctx, mask, time, kind, p0=None, p1=None)
     from shadow1_tpu.consts import NP
 
     p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
-    if p0 is not None:
-        p = p.at[:, 0].set(jnp.asarray(p0, jnp.int32))
-    if p1 is not None:
-        p = p.at[:, 1].set(jnp.asarray(p1, jnp.int32))
+    for i, pi in enumerate((p0, p1, p2, p3)):
+        if pi is not None:
+            p = p.at[:, i].set(jnp.asarray(pi, jnp.int32))
     k = jnp.full(ctx.n_hosts, kind, jnp.int32)
     evbuf, over = push_local(st.evbuf, mask, time, k, p)
     m = st.metrics

@@ -217,6 +217,8 @@ class CpuNetModel:
                 seg_syn, seg_fin = False, False
             mend = mmeta = 0
             if not seg_syn and not seg_fin:
+                # Message-framed segmentation (mirror of tcp.py): truncate at
+                # the first boundary in range so one segment = one message end.
                 seg_hi = seq_add(k.snd_nxt, length)
                 best = None
                 for end, meta in k.mq:
@@ -226,6 +228,7 @@ class CpuNetModel:
                             best = (d, end, meta)
                 if best is not None:
                     mend, mmeta = best[1], best[2]
+                    length = best[0]
             self.emit(h, s, flags, k.snd_nxt, length, mend, mmeta, now)
             k.snd_nxt = seq_add(k.snd_nxt, length + (1 if (seg_syn or seg_fin) else 0))
             if not k.ts_act:
@@ -338,7 +341,11 @@ class CpuNetModel:
                 and c.st not in (TCP_FREE, TCP_LISTEN)
                 for c in socks
             )
-            child = next((i for i, c in enumerate(socks) if c.st == TCP_FREE), None)
+            # Highest free slot (mirror of tcp.py: low slots are app-owned).
+            child = next(
+                (i for i in range(len(socks) - 1, -1, -1) if socks[i].st == TCP_FREE),
+                None,
+            )
             if not dup and child is not None:
                 socks[child].init_conn(pr, src, ss, TCP_SYN_RCVD, 1)
                 socks[child].peer_wnd = wnd

@@ -230,6 +230,10 @@ def tcp_flush(st, ctx, mask, sock, now):
             jnp.where(seg_fin, F_FIN | F_ACK, F_ACK),
         )
         # Message boundary riding this segment: min mq end in (snd_nxt, snd_nxt+len].
+        # A segment can carry at most ONE boundary, so segmentation is
+        # message-framed: the segment is truncated at the first boundary it
+        # covers (otherwise a Go-Back-N rewind could re-coalesce bytes across
+        # several boundaries and silently drop all but the first message).
         seg_hi = snd_nxt + length
         mqv, mqe = r.g("mq_valid"), r.g("mq_end")  # [H, MQ]
         inrange = mqv & ((mqe - snd_nxt[:, None]) > 0) & ((mqe - seg_hi[:, None]) <= 0)
@@ -240,6 +244,7 @@ def tcp_flush(st, ctx, mask, sock, now):
         hh = jnp.arange(ctx.n_hosts)
         mend = jnp.where(has_m, mqe[hh, mi], 0)
         mmeta = jnp.where(has_m, r.g("mq_meta")[hh, mi], 0)
+        length = jnp.where(has_m, dist[hh, mi], length)
 
         st = _emit(st, ctx, r, can, flags, snd_nxt, length, mend, mmeta, now)
         new_nxt = snd_nxt + length + jnp.where(seg_syn | seg_fin, 1, 0)
@@ -412,7 +417,11 @@ def tcp_rx(st, ctx, mask, p, now):
         & (tcp["st"] != TCP_LISTEN)
     ).any(axis=1)
     free = tcp["st"] == TCP_FREE
-    child = jnp.argmax(free, axis=1).astype(jnp.int32)
+    # Children take the HIGHEST free slot: low slots are app-owned (0 =
+    # listener, 1 = client socket on dual-role hosts) and may be TCP_FREE
+    # between uses — allocating from the top keeps them unclobbered.
+    n_s = free.shape[1]
+    child = (n_s - 1 - jnp.argmax(free[:, ::-1], axis=1)).astype(jnp.int32)
     new_conn = syn_to_listen & ~dup & free.any(axis=1)
     rc = Sock(tcp, child, new_conn)
     _init_conn(rc, ctx, new_conn, src, ss, TCP_SYN_RCVD, 1)

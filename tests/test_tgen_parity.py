@@ -1,0 +1,63 @@
+"""tgen model-app parity: batched engine vs CPU oracle (BASELINE rung 2).
+
+A small all-active tgen mesh: every host serves and streams random-sized
+payloads to random peers with think pauses — the shape of the reference's
+100-host tgen bulk-traffic config, scaled down for test time. Parity must
+be exact including under loss.
+"""
+
+import numpy as np
+
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, SEC, EngineParams
+from tests.test_net_parity import assert_parity, run_both
+
+TGEN_KEYS = ("rx_bytes", "streams_served", "streams_done", "done_time")
+
+
+def tgen_exp(n_hosts=12, seed=21, loss=0.0, streams=2, mean_bytes=20_000,
+             end=30 * SEC, bw=10**7):
+    return single_vertex_experiment(
+        n_hosts=n_hosts,
+        seed=seed,
+        end_time=end,
+        latency_ns=10 * MS,
+        loss=loss,
+        bw_bits=bw,
+        model="net",
+        model_cfg={
+            "app": "tgen",
+            "active": np.ones(n_hosts, np.int64),
+            "streams": np.full(n_hosts, streams, np.int64),
+            "mean_bytes": np.full(n_hosts, mean_bytes, np.float64),
+            "mean_think_ns": np.full(n_hosts, 50 * MS, np.float64),
+            "start_time": np.full(n_hosts, 1 * MS, np.int64),
+        },
+    )
+
+
+def test_tgen_mesh_parity():
+    exp = tgen_exp()
+    cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=256))
+    # All clients finish their streams within the horizon.
+    assert int(ts["total_streams_done"]) == 12 * 2
+    assert int(ts["total_streams_served"]) == 12 * 2
+    assert int(ts["total_rx_bytes"]) > 0
+    assert_parity(cm, cs, tm, ts, keys=TGEN_KEYS)
+
+
+def test_tgen_mesh_under_loss_parity():
+    exp = tgen_exp(seed=8, loss=0.02, mean_bytes=30_000, end=60 * SEC)
+    cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=256))
+    assert int(ts["total_streams_done"]) == 12 * 2
+    assert tm["tcp_rto"] + tm["tcp_fast_rtx"] > 0
+    assert_parity(cm, cs, tm, ts, keys=TGEN_KEYS)
+
+
+def test_tgen_fixed_size_parity():
+    exp = tgen_exp(n_hosts=6, seed=4, streams=3, mean_bytes=15_000, end=30 * SEC)
+    exp.model_cfg["fixed_size"] = True
+    cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=256))
+    assert int(ts["total_streams_done"]) == 6 * 3
+    assert int(ts["total_rx_bytes"]) == 6 * 3 * 15_000
+    assert_parity(cm, cs, tm, ts, keys=TGEN_KEYS)
