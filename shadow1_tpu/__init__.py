@@ -35,4 +35,10 @@ import jax
 # explicitly dtyped (f32) so this does not silently promote compute to f64.
 jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: engine round bodies are large programs
+# (minutes to compile); caching makes repeat CLI/bench/test invocations
+# start in seconds.
+jax.config.update("jax_compilation_cache_dir", "/tmp/shadow1_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 __version__ = "0.1.0"

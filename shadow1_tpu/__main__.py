@@ -1,0 +1,3 @@
+from shadow1_tpu.cli import main
+
+raise SystemExit(main())

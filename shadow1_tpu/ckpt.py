@@ -1,0 +1,63 @@
+"""Checkpoint / resume — snapshot the engine state pytree, continue later.
+
+The reference has NO checkpointing (SURVEY §5: impossible with real process
+memory in v1.x). Here engine state is a pytree of arrays, so a snapshot is
+just the flattened tree serialized to one .npz file; resume loads it back
+into the treedef of a freshly-initialized state and continues the window
+loop. Determinism makes this exact: a run that checkpoints and resumes
+produces bit-identical results to an uninterrupted run (tested in
+tests/test_ckpt.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+
+def _flatten(st):
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    return leaves, treedef
+
+
+def save_state(st, path: str) -> None:
+    """Snapshot a SimState pytree to ``path`` (.npz)."""
+    leaves, _ = _flatten(st)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    np.savez_compressed(path, **arrays)
+
+
+def load_state(template, path: str):
+    """Load a snapshot into the structure of ``template`` (a SimState from
+    ``engine.init_state()``) — shapes/dtypes must match the engine config."""
+    tleaves, treedef = _flatten(template)
+    with np.load(path) as data:
+        leaves = [data[f"leaf_{i}"] for i in range(len(tleaves))]
+    for i, (have, want) in enumerate(zip(leaves, tleaves)):
+        w = np.asarray(want)
+        if have.shape != w.shape or have.dtype != w.dtype:
+            raise ValueError(
+                f"checkpoint leaf {i}: {have.shape}/{have.dtype} != "
+                f"engine state {w.shape}/{w.dtype} — config mismatch"
+            )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def run_chunked(engine, st=None, n_windows: int | None = None,
+                chunk: int = 0, on_chunk=None):
+    """Run in fixed-size window chunks, invoking ``on_chunk(st, done)`` after
+    each (for checkpoints/heartbeats). One compiled program is reused for
+    every full chunk. Returns the final state."""
+    if st is None:
+        st = engine.init_state()
+    total = n_windows if n_windows is not None else engine.n_windows
+    if chunk <= 0:
+        chunk = total
+    done = 0
+    while done < total:
+        step = min(chunk, total - done)
+        st = engine.run(st, n_windows=step)
+        done += step
+        if on_chunk is not None:
+            on_chunk(st, done)
+    return st

@@ -1,0 +1,114 @@
+"""Command-line runner: ``python -m shadow1_tpu <config.yaml> [options]``.
+
+The analogue of the reference's ``shadow [options] shadow.config.xml`` entry
+point (src/main/main.c + core/support/options.c): one experiment file, an
+engine selector, and end-of-run metrics. The ``--engine`` flag overrides the
+config's ``engine.scheduler`` the way the reference's CLI flags override its
+config values.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shadow1_tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    ap.add_argument("config", help="YAML experiment file")
+    ap.add_argument("--engine", choices=["cpu", "tpu", "sharded"], default=None,
+                    help="override engine.scheduler from the config")
+    ap.add_argument("--windows", type=int, default=None,
+                    help="run only this many conservative windows")
+    ap.add_argument("--summary", action="store_true",
+                    help="also print per-host model summary totals")
+    ap.add_argument("--heartbeat", type=int, default=None, metavar="W",
+                    help="emit a heartbeat line to stderr every W windows")
+    ap.add_argument("--save-state", default=None, metavar="PATH",
+                    help="snapshot final engine state to PATH (.npz)")
+    ap.add_argument("--resume", default=None, metavar="PATH",
+                    help="resume from a state snapshot (batched engines)")
+    args = ap.parse_args(argv)
+
+    import shadow1_tpu  # noqa: F401  (x64 before jax arrays)
+    from shadow1_tpu.config.experiment import load_experiment
+
+    exp, params, scheduler = load_experiment(args.config)
+    engine_kind = args.engine or scheduler
+    if engine_kind == "cpu" and (args.save_state or args.resume or args.heartbeat):
+        ap.error("--save-state/--resume/--heartbeat require a batched engine "
+                 "(tpu or sharded)")
+    t0 = time.perf_counter()
+    metrics0: dict[str, int] = {}
+
+    if engine_kind == "cpu":
+        from shadow1_tpu.cpu_engine import CpuEngine
+
+        eng = CpuEngine(exp, params)
+        metrics = eng.run(n_windows=args.windows)
+        summary = eng.summary()
+        n_windows = args.windows if args.windows is not None else eng.n_windows
+    else:
+        import jax
+
+        if engine_kind == "sharded":
+            from shadow1_tpu.shard.engine import ShardedEngine as Eng
+        else:
+            from shadow1_tpu.core.engine import Engine as Eng
+        eng = Eng(exp, params)
+        st = None
+        if args.resume:
+            from shadow1_tpu.ckpt import load_state
+
+            st = load_state(eng.init_state(), args.resume)
+            metrics0 = Eng.metrics_dict(st)
+        if args.heartbeat:
+            from shadow1_tpu.obs import run_with_heartbeat
+
+            st, _hb = run_with_heartbeat(
+                eng, st, n_windows=args.windows, every_windows=args.heartbeat
+            )
+        else:
+            st = eng.run(st, n_windows=args.windows)
+        jax.block_until_ready(st)
+        if args.save_state:
+            from shadow1_tpu.ckpt import save_state
+
+            save_state(st, args.save_state)
+        metrics = Eng.metrics_dict(st)
+        summary = eng.model_summary(st)
+        n_windows = args.windows if args.windows is not None else eng.n_windows
+
+    wall = time.perf_counter() - t0
+    sim_s = n_windows * exp.window / 1e9
+    # Rates cover THIS invocation: under --resume, cumulative checkpointed
+    # metrics are baselined out.
+    ev_run = metrics["events"] - metrics0.get("events", 0)
+    out = {
+        "engine": engine_kind,
+        "hosts": exp.n_hosts,
+        "window_ns": exp.window,
+        "windows": n_windows,
+        "sim_seconds": round(sim_s, 6),
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(sim_s / wall, 3) if wall > 0 else None,
+        "events_per_sec": round(ev_run / wall, 1) if wall > 0 else None,
+        "resumed": bool(args.resume),
+        "metrics": {k: int(v) for k, v in metrics.items()},
+    }
+    if args.summary:
+        out["summary"] = {
+            k: int(v) for k, v in summary.items()
+            if getattr(v, "ndim", 1) == 0 or isinstance(v, (int, float))
+        }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,327 @@
+"""YAML experiment files → CompiledExperiment (the engine-neutral artifact).
+
+The reference's experiment file is XML: <topology> (GraphML), <host> specs
+with quantity/bandwidth/start times, and plugin args
+(src/main/core/support/configuration.c). This YAML schema preserves those
+concepts — network / hosts / app / engine sections — and adds the
+`engine.scheduler: cpu|tpu|sharded` selector mandated by BASELINE.json
+("CPU and TPU engines are selected from the same config file").
+
+Schema:
+
+    general:
+      seed: 1
+      stop_time: 60 s            # durations: "<num> <ns|us|ms|s>" or int ns
+    engine:
+      scheduler: tpu             # cpu | tpu | sharded
+      ev_cap: 256                # any EngineParams field
+    network:
+      graphml: path.graphml      # or:
+      single_vertex: {latency: 10 ms, loss: 0.01}
+    hosts:                       # expanded in order into host ids 0..H-1
+      - name: relay
+        count: 8
+        vertex: 0                # attachment PoP (int id or GraphML node id,
+                                 # or "spread" = round-robin over vertices)
+        bandwidth_up: 100 Mbit   # "<num> <bit|Kbit|Mbit|Gbit>"/s
+        bandwidth_down: 100 Mbit
+    app:
+      model: tgen                # tgen|tor|bitcoin|filexfer|dgram|phold
+      params: {...}              # global scalars (engine-level knobs)
+      defaults: {...}            # per-host params, broadcast to all hosts
+      groups:                    # per-host params, per host group
+        relay: {...}
+
+Per-host values may be scalars or lists of length == group count. Durations
+and bandwidths accept the unit strings above anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from shadow1_tpu.config.compiled import CompiledExperiment
+from shadow1_tpu.config.topology import compile_paths, load_graphml
+from shadow1_tpu.consts import MS, NS, SEC, US, EngineParams
+
+_TIME_UNITS = {"ns": NS, "us": US, "ms": MS, "s": SEC, "sec": SEC}
+_BW_UNITS = {"bit": 1, "kbit": 10**3, "mbit": 10**6, "gbit": 10**9}
+
+
+def parse_time_ns(v) -> int:
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return int(v)
+    s = str(v).strip().lower()
+    parts = s.split()
+    if len(parts) == 2 and parts[1] in _TIME_UNITS:
+        return int(float(parts[0]) * _TIME_UNITS[parts[1]])
+    for unit in ("ns", "us", "ms", "sec", "s"):
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)]) * _TIME_UNITS[unit])
+    return int(float(s))
+
+
+def parse_bw_bits(v) -> int:
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return int(v)
+    s = str(v).strip().lower().replace("/s", "")
+    parts = s.split()
+    if len(parts) == 2 and parts[1] in _BW_UNITS:
+        return int(float(parts[0]) * _BW_UNITS[parts[1]])
+    for unit in ("kbit", "mbit", "gbit", "bit"):
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)]) * _BW_UNITS[unit])
+    return int(float(s))
+
+
+@dataclasses.dataclass
+class HostGroup:
+    name: str
+    count: int
+    start: int          # first global host id
+    vertex_spec: Any
+    bw_up: int
+    bw_dn: int
+
+    @property
+    def ids(self) -> np.ndarray:
+        return np.arange(self.start, self.start + self.count)
+
+
+# Per-host app parameter schemas: name -> (dtype, default, parser).
+# A parser of parse_time_ns lets YAML say "100 ms" for per-host times.
+_T = parse_time_ns
+_APP_PARAMS: dict[str, dict[str, tuple]] = {
+    "filexfer": {
+        "role": (np.int64, 2, None),
+        "server": (np.int64, 0, None),
+        "flow_bytes": (np.int64, 0, None),
+        "start_time": (np.int64, 0, _T),
+        "flow_count": (np.int64, 0, None),
+    },
+    "dgram": {
+        "dst": (np.int64, 0, None),
+        "payload": (np.int64, 0, None),
+        "interval": (np.int64, 0, _T),
+        "count": (np.int64, 0, None),
+        "start_time": (np.int64, 0, _T),
+    },
+    "tgen": {
+        "active": (np.int64, 0, None),
+        "streams": (np.int64, 0, None),
+        "mean_bytes": (np.float64, 0.0, None),
+        "mean_think_ns": (np.float64, 0.0, _T),
+        "start_time": (np.int64, 0, _T),
+    },
+    "tor": {
+        "role": (np.int64, 3, None),
+        "relay_weight": (np.int64, 0, None),
+        "is_guard": (bool, False, None),
+        "is_exit": (bool, False, None),
+        "n_circuits": (np.int64, 0, None),
+        "n_streams": (np.int64, 0, None),
+        "mean_stream_cells": (np.float64, 0.0, None),
+        "mean_think_ns": (np.float64, 0.0, _T),
+        "start_time": (np.int64, 0, _T),
+    },
+    "bitcoin": {},  # graph-structured config passes through `params`
+    "phold": {},
+}
+
+
+def _expand_hosts(spec: list[dict]) -> list[HostGroup]:
+    groups, start = [], 0
+    for g in spec:
+        count = int(g.get("count", 1))
+        groups.append(HostGroup(
+            name=g["name"],
+            count=count,
+            start=start,
+            vertex_spec=g.get("vertex", 0),
+            bw_up=parse_bw_bits(g.get("bandwidth_up", "1 Gbit")),
+            bw_dn=parse_bw_bits(g.get("bandwidth_down", "1 Gbit")),
+        ))
+        start += count
+    return groups
+
+
+def _vertex_assignment(groups, vertex_names, n_hosts) -> np.ndarray:
+    n_v = max(len(vertex_names), 1)
+    name_idx = {str(n): i for i, n in enumerate(vertex_names)}
+    hv = np.zeros(n_hosts, np.int32)
+    for g in groups:
+        if g.vertex_spec == "spread":
+            hv[g.start:g.start + g.count] = np.arange(g.count) % n_v
+        elif isinstance(g.vertex_spec, int):
+            hv[g.start:g.start + g.count] = g.vertex_spec
+        else:
+            hv[g.start:g.start + g.count] = name_idx[str(g.vertex_spec)]
+    assert hv.max(initial=0) < n_v, "host attached to missing vertex"
+    return hv
+
+
+def _per_host_array(name, dtype, default, parser, groups, defaults, group_cfg, h):
+    arr = np.full(h, default, dtype)
+    conv = parser or (lambda x: x)
+    if name in defaults:
+        arr[:] = conv(defaults[name])
+    for g in groups:
+        block = group_cfg.get(g.name, {})
+        if name in block:
+            val = block[name]
+            if isinstance(val, list):
+                assert len(val) == g.count, (name, g.name)
+                arr[g.ids] = [conv(x) for x in val]
+            else:
+                arr[g.ids] = conv(val)
+    return arr
+
+
+def _gen_bitcoin_cfg(model_cfg: dict, h: int, seed: int) -> None:
+    """Expand bitcoin's generator specs into concrete arrays.
+
+    ``graph: {kind: ring_chord, k: K}`` → symmetric K-regular peer graph
+    (ring ±1 plus power-of-4 chords); ``tx: {count, start, interval}`` →
+    staggered transactions at config-RNG-chosen origins. Explicit ``peers``
+    / ``tx_origin`` / ``tx_time`` arrays may be given instead.
+    """
+    if "peers" not in model_cfg:
+        gspec = model_cfg.pop("graph", {})
+        k = int(gspec.get("k", 8))
+        assert k % 2 == 0 and k >= 2
+        chords = [1]
+        while len(chords) < k // 2:
+            chords.append(chords[-1] * 4)
+        peers = np.zeros((h, k), np.int32)
+        for ci, c in enumerate(chords):
+            peers[:, 2 * ci] = (np.arange(h) - c) % h
+            peers[:, 2 * ci + 1] = (np.arange(h) + c) % h
+        model_cfg["peers"] = peers
+    if "tx_origin" not in model_cfg:
+        tspec = model_cfg.pop("tx", {})
+        count = int(tspec.get("count", 50))
+        start = parse_time_ns(tspec.get("start", "1 s"))
+        interval = parse_time_ns(tspec.get("interval", "200 ms"))
+        rs = np.random.RandomState(seed ^ 0xB17C01)  # config-gen only
+        model_cfg["tx_origin"] = rs.randint(0, h, count).astype(np.int64)
+        model_cfg["tx_time"] = (start + np.arange(count) * interval).astype(np.int64)
+
+
+def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment, EngineParams, str]:
+    """YAML document → (CompiledExperiment, EngineParams, scheduler)."""
+    import os
+
+    gen = doc.get("general", {})
+    seed = int(gen.get("seed", 1))
+    end_time = parse_time_ns(gen.get("stop_time", "10 s"))
+
+    # -- engine ------------------------------------------------------------
+    eng = dict(doc.get("engine", {}))
+    scheduler = eng.pop("scheduler", "tpu")
+    valid = {f.name for f in dataclasses.fields(EngineParams)}
+    unknown = set(eng) - valid
+    assert not unknown, f"unknown engine params: {unknown}"
+    params = EngineParams(**{k: int(v) for k, v in eng.items()})
+
+    # -- network -----------------------------------------------------------
+    net = doc.get("network", {})
+    if "graphml" in net:
+        path = net["graphml"]
+        if not os.path.isabs(path):
+            path = os.path.join(base_dir, path)
+        names, lat_e, loss_e, directed = load_graphml(path)
+        lat_vv, loss_vv = compile_paths(lat_e, loss_e, directed=directed)
+    else:
+        sv = net.get("single_vertex", {})
+        names = ["v0"]
+        lat_vv = np.full((1, 1), parse_time_ns(sv.get("latency", "10 ms")), np.int64)
+        loss_vv = np.full((1, 1), float(sv.get("loss", 0.0)), np.float32)
+
+    # -- hosts -------------------------------------------------------------
+    groups = _expand_hosts(doc.get("hosts", [{"name": "host", "count": 1}]))
+    h = sum(g.count for g in groups)
+    host_vertex = _vertex_assignment(groups, names, h)
+    bw_up = np.zeros(h, np.int64)
+    bw_dn = np.zeros(h, np.int64)
+    for g in groups:
+        bw_up[g.ids] = g.bw_up
+        bw_dn[g.ids] = g.bw_dn
+
+    # -- app ---------------------------------------------------------------
+    appsec = doc.get("app", {"model": "phold"})
+    app = appsec["model"]
+    model_cfg: dict[str, Any] = dict(appsec.get("params", {}))
+    schema = _APP_PARAMS.get(app)
+    assert schema is not None, f"unknown app model {app!r}"
+
+    # Group-name references: "@name" → first host id of that group (e.g.
+    # filexfer's `server: "@server"`), resolved before array building.
+    by_name = {g.name: g for g in groups}
+
+    def resolve(tree):
+        if isinstance(tree, str) and tree.startswith("@"):
+            return by_name[tree[1:]].start
+        if isinstance(tree, dict):
+            return {k: resolve(v) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [resolve(v) for v in tree]
+        return tree
+
+    defaults = resolve(appsec.get("defaults", {}))
+    group_cfg = resolve(appsec.get("groups", {}))
+    model_cfg = resolve(model_cfg)
+    # Typos fail loudly, like the engine section: every defaults/groups key
+    # must name a schema parameter, every groups key a host group.
+    if schema:
+        allowed = set(schema)
+        assert set(defaults) <= allowed, \
+            f"unknown app.defaults params: {set(defaults) - allowed}"
+        host_names = {g.name for g in groups}
+        assert set(group_cfg) <= host_names, \
+            f"unknown app.groups host groups: {set(group_cfg) - host_names}"
+        for gname, block in group_cfg.items():
+            assert set(block) <= allowed, \
+                f"unknown params in app.groups.{gname}: {set(block) - allowed}"
+    for pname, (dtype, default, parser) in schema.items():
+        model_cfg[pname] = _per_host_array(
+            pname, dtype, default, parser, groups, defaults, group_cfg, h
+        )
+
+    if app == "bitcoin":
+        _gen_bitcoin_cfg(model_cfg, h, seed)
+    if app == "phold":
+        model_cfg.setdefault("mean_delay_ns", float(10 * MS))
+        model = "phold"
+    else:
+        model_cfg["app"] = app
+        model = "net"
+
+    exp = CompiledExperiment(
+        n_hosts=h,
+        seed=seed,
+        end_time=end_time,
+        lat_vv=lat_vv,
+        loss_vv=loss_vv,
+        host_vertex=host_vertex,
+        bw_up=bw_up,
+        bw_dn=bw_dn,
+        model=model,
+        model_cfg=model_cfg,
+    )
+    exp.validate()
+    return exp, params, scheduler
+
+
+def load_experiment(path: str):
+    """Load a YAML experiment file → (CompiledExperiment, EngineParams,
+    scheduler)."""
+    import os
+
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return build_experiment(doc, base_dir=os.path.dirname(os.path.abspath(path)))

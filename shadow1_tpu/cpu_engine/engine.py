@@ -117,7 +117,7 @@ class CpuEngine:
 
     # -- main loop ---------------------------------------------------------
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
-        end = (n_windows or self.n_windows) * self.window
+        end = (self.n_windows if n_windows is None else n_windows) * self.window
         while self.heap and self.heap[0][0] < end:
             time, _tb, _g, host, kind, p = heapq.heappop(self.heap)
             self.pending[host] -= 1

@@ -1,0 +1,73 @@
+"""Observability — the heartbeat metrics stream.
+
+The reference's Tracker logs per-host statistics at a configured interval
+and its log records carry sim-time + wall-time so the sim/wall ratio is
+derivable (src/main/host/tracker.c, SURVEY §5). The batched analogue: run
+the window loop in chunks and emit one structured heartbeat per chunk with
+the metric deltas — events/sec, packets, retransmits, overflow counters —
+without ever synchronizing device→host inside a window.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from shadow1_tpu.ckpt import run_chunked
+from shadow1_tpu.consts import SEC
+
+
+class Heartbeat:
+    """Collects per-chunk metric deltas; writes JSON lines to ``stream``."""
+
+    def __init__(self, engine, stream=None, label: str = "heartbeat",
+                 initial_state=None):
+        self.engine = engine
+        self.stream = stream if stream is not None else sys.stderr
+        self.label = label
+        self.t_start = time.perf_counter()
+        self.t_last = self.t_start
+        # Seed the baseline from a resumed state so the first delta covers
+        # only this invocation, not the checkpointed history.
+        self.last: dict[str, int] = (
+            {k: int(v) for k, v in initial_state.metrics._asdict().items()}
+            if initial_state is not None else {}
+        )
+        self.records: list[dict] = []
+
+    def __call__(self, st, done_windows: int) -> None:
+        now = time.perf_counter()
+        m = {k: int(v) for k, v in st.metrics._asdict().items()}
+        delta = {k: v - self.last.get(k, 0) for k, v in m.items()}
+        dt = now - self.t_last
+        sim_ns = int(st.win_start)  # the true sim clock (resume-aware)
+        rec = {
+            "type": self.label,
+            "sim_time_s": round(sim_ns / SEC, 6),
+            "wall_s": round(now - self.t_start, 3),
+            "windows": done_windows,
+            "events_per_sec": round(delta["events"] / dt, 1) if dt > 0 else None,
+            "sim_per_wall": round((self.engine.window * delta["windows"] / SEC) / dt, 4)
+            if dt > 0 else None,
+            "delta": delta,
+        }
+        self.records.append(rec)
+        if self.stream:
+            print(json.dumps(rec), file=self.stream, flush=True)
+        self.t_last = now
+        self.last = m
+
+
+def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
+                       stream=None):
+    """Run the engine emitting a heartbeat every ``every_windows`` windows.
+
+    Returns (final_state, heartbeat) — heartbeat.records holds the stream.
+    """
+    total = n_windows if n_windows is not None else engine.n_windows
+    if every_windows is None:
+        every_windows = max(total // 10, 1)
+    hb = Heartbeat(engine, stream=stream, initial_state=st)
+    st = run_chunked(engine, st, n_windows=total, chunk=every_windows, on_chunk=hb)
+    return st, hb

@@ -1,0 +1,86 @@
+"""Checkpoint/resume exactness + heartbeat stream.
+
+Determinism makes checkpointing exact: run A→(save)→resume→B must equal an
+uninterrupted A+B run bit-for-bit — the engine-state analogue of the
+reference's determinism diff-test (SURVEY §4).
+"""
+
+import io
+import json
+
+import jax
+import numpy as np
+
+from shadow1_tpu.ckpt import load_state, run_chunked, save_state
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.obs import run_with_heartbeat
+
+
+def phold_engine():
+    exp = single_vertex_experiment(
+        n_hosts=32,
+        seed=17,
+        end_time=100 * MS,
+        latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 2},
+    )
+    return Engine(exp, EngineParams())
+
+
+def state_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    eng = phold_engine()
+    # Uninterrupted 100-window run.
+    ref = eng.run(n_windows=100)
+    # 40 windows → snapshot → load → 60 more.
+    st = eng.run(n_windows=40)
+    path = str(tmp_path / "snap.npz")
+    save_state(st, path)
+    st2 = load_state(eng.init_state(), path)
+    final = eng.run(st2, n_windows=60)
+    assert state_equal(ref, final)
+
+
+def test_checkpoint_rejects_config_mismatch(tmp_path):
+    eng = phold_engine()
+    st = eng.run(n_windows=10)
+    path = str(tmp_path / "snap.npz")
+    save_state(st, path)
+    other = Engine(
+        single_vertex_experiment(
+            n_hosts=64, seed=17, end_time=100 * MS, latency_ns=1 * MS,
+            model="phold", model_cfg={"mean_delay_ns": float(2 * MS)},
+        ),
+        EngineParams(),
+    )
+    try:
+        load_state(other.init_state(), path)
+        raise AssertionError("expected ValueError on shape mismatch")
+    except ValueError as e:
+        assert "config mismatch" in str(e)
+
+
+def test_run_chunked_matches_straight_run():
+    eng = phold_engine()
+    ref = eng.run(n_windows=100)
+    chunked = run_chunked(eng, n_windows=100, chunk=17)  # uneven tail chunk
+    assert state_equal(ref, chunked)
+
+
+def test_heartbeat_stream():
+    eng = phold_engine()
+    buf = io.StringIO()
+    st, hb = run_with_heartbeat(eng, n_windows=100, every_windows=25, stream=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert len(lines) == 4
+    assert lines[-1]["windows"] == 100
+    assert sum(r["delta"]["events"] for r in lines) == int(st.metrics.events)
+    assert all(r["type"] == "heartbeat" for r in lines)
