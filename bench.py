@@ -7,9 +7,15 @@ CPU reference engine, and prints ONE JSON line:
     {"metric": "phold_events_per_sec", "value": N, "unit": "events/s",
      "vs_baseline": tpu_events_per_sec / cpu_engine_events_per_sec, ...}
 
-The CPU comparator here is this repo's own reference engine (BASELINE.md:
-no external numbers exist in-environment); the native thread-per-core
-comparator lands with the C++ engine milestone.
+Robustness contract (round-1 postmortem): this script ALWAYS prints exactly
+one JSON line on stdout. The accelerator backend is probed in a subprocess
+with a deadline (shadow1_tpu.platform); if it is down or hangs, the batched
+engine runs on the forced-CPU platform and the ``backend`` field labels that
+honestly. Any unexpected failure still emits a JSON line with an ``error``
+detail instead of a stack trace.
+
+The CPU comparator is this repo's own reference engine (BASELINE.md: no
+external numbers exist in-environment).
 """
 
 from __future__ import annotations
@@ -17,12 +23,9 @@ from __future__ import annotations
 import json
 import time
 
-import shadow1_tpu  # noqa: F401  (x64 on, before jax arrays exist)
 
-
-def main() -> None:
+def run_bench() -> dict:
     import jax
-    import numpy as np
 
     from shadow1_tpu.config.compiled import single_vertex_experiment
     from shadow1_tpu.consts import MS, SEC, EngineParams
@@ -46,8 +49,10 @@ def main() -> None:
     eng = Engine(exp, params)
     # Warm-up at the FULL window count: n_windows is a jit static arg, so the
     # timed call below must reuse this exact compiled program.
+    t0 = time.perf_counter()
     st = eng.run()
     jax.block_until_ready(st)
+    compile_wall = time.perf_counter() - t0
     t0 = time.perf_counter()
     st = eng.run()
     jax.block_until_ready(st)
@@ -65,7 +70,7 @@ def main() -> None:
     cpu_eps = cm["events"] / cpu_wall
 
     sim_per_wall = (eng.n_windows * exp.window / SEC) / tpu_wall
-    print(json.dumps({
+    return {
         "metric": "phold_events_per_sec",
         "value": round(tpu_eps, 1),
         "unit": "events/s",
@@ -74,6 +79,7 @@ def main() -> None:
             "n_hosts": n_hosts,
             "events": m["events"],
             "tpu_wall_s": round(tpu_wall, 3),
+            "compile_plus_first_run_s": round(compile_wall, 3),
             "sim_sec_per_wall_sec": round(sim_per_wall, 3),
             "cpu_engine_events_per_sec": round(cpu_eps, 1),
             "backend": jax.default_backend(),
@@ -81,7 +87,32 @@ def main() -> None:
             "ev_overflow": m["ev_overflow"],
             "ob_overflow": m["ob_overflow"],
         },
-    }))
+    }
+
+
+def main() -> None:
+    result = None
+    try:
+        import shadow1_tpu  # noqa: F401  (x64 on, before jax arrays exist)
+        from shadow1_tpu.platform import ensure_live_platform, probe_default_backend
+
+        ensure_live_platform(min_devices=1)
+        probe = probe_default_backend()
+        result = run_bench()
+        if probe.get("error"):
+            result["detail"]["backend_probe_error"] = probe["error"]
+    except Exception as e:  # noqa: BLE001 — the JSON line must always print
+        import traceback
+
+        result = {
+            "metric": "phold_events_per_sec",
+            "value": None,
+            "unit": "events/s",
+            "vs_baseline": None,
+            "error": repr(e),
+            "detail": {"traceback": traceback.format_exc()[-2000:]},
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -40,6 +40,15 @@ def main(argv=None) -> int:
 
     exp, params, scheduler = load_experiment(args.config)
     engine_kind = args.engine or scheduler
+    # Survive a dead/hanging accelerator backend. The CPU oracle needs jax
+    # too (it mirrors the RNG streams), but never an accelerator — force
+    # CPU directly and skip the probe cost.
+    from shadow1_tpu.platform import ensure_live_platform, force_cpu
+
+    if engine_kind == "cpu":
+        force_cpu(1)
+    else:
+        ensure_live_platform(min_devices=1)
     if engine_kind == "cpu" and (args.save_state or args.resume or args.heartbeat):
         ap.error("--save-state/--resume/--heartbeat require a batched engine "
                  "(tpu or sharded)")
@@ -67,6 +76,11 @@ def main(argv=None) -> int:
 
             st = load_state(eng.init_state(), args.resume)
             metrics0 = Eng.metrics_dict(st)
+            if args.windows is None:
+                # Complete the configured run: only the windows remaining
+                # after the checkpoint, not n_windows again on top of it.
+                done = int(st.win_start) // exp.window
+                args.windows = max(eng.n_windows - done, 0)
         if args.heartbeat:
             from shadow1_tpu.obs import run_with_heartbeat
 
