@@ -1,0 +1,65 @@
+"""Dense per-row update/select primitives — the no-scatter toolkit.
+
+XLA lowers a scatter with dynamic per-row indices (``arr.at[h, col].set``)
+to a serialized loop on TPU: measured 4.3 ms for a [4096, 32] single-slot
+write and 371 ms for a 131k-element batch scatter — the entire per-window
+cost of round 2's engine. Every hot-path "write one slot per row" in this
+package therefore goes through these helpers, which express the update as a
+one-hot mask + ``where`` (dense, fuses into one cheap elementwise kernel)
+instead of a scatter. Reads keep ``take_along_axis`` (gathers are fast).
+
+The semantics are exactly those of ``arr.at[h, col].set(val)`` with an
+out-of-range drop: rows where ``mask`` is False (or ``col`` out of range)
+are untouched.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def onehot_col(col, cap: int, mask=None) -> jnp.ndarray:
+    """bool [H, cap]: True at (h, col[h]) where mask[h] (and col in range)."""
+    sel = jnp.arange(cap, dtype=col.dtype)[None, :] == col[:, None]
+    if mask is not None:
+        sel = sel & mask[:, None]
+    return sel
+
+
+def set_col(arr, col, val, mask=None):
+    """Dense ``arr[h, col[h]] = val[h] where mask[h]`` for [H, C, ...] arrays.
+
+    ``val`` may be scalar or [H] (or [H, ...] matching trailing dims)."""
+    sel = onehot_col(col, arr.shape[1], mask)
+    val = jnp.asarray(val, arr.dtype)
+    if val.ndim == 0:
+        return jnp.where(_expand(sel, arr.ndim), val, arr)
+    # val [H] or [H, trailing...] -> broadcast over the slot axis.
+    val = jnp.expand_dims(val, 1)
+    return jnp.where(_expand(sel, arr.ndim), val, arr)
+
+
+def add_col(arr, col, val, mask=None):
+    """Dense ``arr[h, col[h]] += val[h] where mask[h]``."""
+    sel = onehot_col(col, arr.shape[1], mask)
+    val = jnp.asarray(val, arr.dtype)
+    if val.ndim >= 1:
+        val = jnp.expand_dims(val, 1)
+    return arr + jnp.where(_expand(sel, arr.ndim), val, jnp.zeros((), arr.dtype))
+
+
+def get_col(arr, col):
+    """Gather ``arr[h, col[h]]`` (col clipped into range; gathers are cheap)."""
+    c = jnp.clip(col, 0, arr.shape[1] - 1)
+    idx = c.reshape(c.shape + (1,) * (arr.ndim - 1))
+    return jnp.take_along_axis(arr, idx, axis=1)[:, 0]
+
+
+def first_true(m) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row first True of a bool [H, C]: (any[H], onehot [H, C])."""
+    sel = m & (jnp.cumsum(m, axis=1) == 1)
+    return m.any(axis=1), sel
+
+
+def _expand(sel, ndim):
+    return sel.reshape(sel.shape + (1,) * (ndim - sel.ndim))

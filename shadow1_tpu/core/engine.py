@@ -94,11 +94,20 @@ class Ctx:
     bw_dn: jax.Array        # i64 [H] local
     model_cfg: dict
     hosts: jax.Array = None  # i32 [H] global host ids of this block
+    loss_thr_vv: jax.Array = None  # u64 [V, V] Bernoulli thresholds
 
     def __post_init__(self):
         if self.hosts is None:
             # Single-device default: the block IS the whole host range.
             object.__setattr__(self, "hosts", jnp.arange(self.n_hosts, dtype=jnp.int32))
+        if self.loss_thr_vv is None:
+            # Integer loss thresholds, computed host-side once (numpy) so no
+            # float op survives in the per-window path (round-2 postmortem).
+            object.__setattr__(
+                self,
+                "loss_thr_vv",
+                jnp.asarray(rng.prob_threshold(np.asarray(self.loss_vv))),
+            )
 
 
 Handler = Callable[[SimState, Popped], SimState]
@@ -150,7 +159,13 @@ class FlatPackets(NamedTuple):
 
 
 def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
-    """One inner round: per-host pop-min + every handler's masked pass."""
+    """One inner round: per-host pop-min + the handler passes.
+
+    Each kind's pass is wrapped in ``lax.cond`` on "any host popped this
+    kind this round" — most rounds touch 1–2 of the 5 kinds, so skipping
+    the dead passes cuts the round cost correspondingly (handlers draw RNG
+    and advance counters only where masked, so an all-false pass is a
+    no-op by construction and skipping it is exact)."""
     evbuf, ev = pop_until(st.evbuf, win_end)
     m = st.metrics
     st = st._replace(
@@ -160,8 +175,13 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
             rounds=m.rounds + 1,
         ),
     )
-    for _kind, fn in sorted(handlers.items()):
-        st = fn(st, ev)
+    items = sorted(handlers.items())
+    for kind, fn in items:
+        if len(items) == 1:
+            st = fn(st, ev)
+        else:
+            present = (ev.mask & (ev.kind == kind)).any()
+            st = jax.lax.cond(present, fn, lambda s, _e: s, st, ev)
     return st
 
 
@@ -184,7 +204,9 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.nd
     vd = ctx.host_vertex[fdst_safe]
     arrival = flat(ob.depart) + ctx.lat_vv[vs, vd]
     bits = rng.bits_v(ctx.key, R_LOSS, fsrc, flat(ob.ctr))
-    lost = fmask & (rng.uniform01(bits) < ctx.loss_vv[vs, vd])
+    # Integer Bernoulli on precomputed thresholds (rng.prob_threshold) —
+    # shared with the CPU oracle, backend-exact by construction.
+    lost = fmask & rng.uniform_lt(bits, ctx.loss_thr_vv[vs, vd])
     keep = fmask & ~lost
     tb = packet_tb(fsrc.astype(jnp.int64), flat(ob.ctr))
     fp = FlatPackets(

@@ -5,7 +5,7 @@ queue for cross-thread pushes (src/main/core/scheduler/*,
 src/main/utility/priority-queue.c). Here all H queues live in one set of
 fixed-capacity SoA tensors ``[H, C]``; pop-min is a masked two-stage argmin,
 local push writes the first free slot, and cross-host delivery is a sorted
-batch scatter performed once per conservative window (SURVEY §7.1).
+batch merge performed once per conservative window (SURVEY §7.1).
 
 Total event order matches the reference's (time, host, seq) comparator
 (src/main/core/work/event.c): within a host, events pop by (time, tb) where
@@ -13,6 +13,12 @@ Total event order matches the reference's (time, host, seq) comparator
 the host's own monotone counter, delivered packets use
 ``consts.packet_tb(src_host, src_pkt_counter)``. Both engines compute the
 same keys, so event order is engine-independent.
+
+TPU note: every update here is expressed densely (one-hot + where, or a
+sort + segment gather) — no dynamic-index scatters, which XLA serializes on
+TPU (see core/dense.py). The delivery merge is gather-style: each free slot
+computes which incoming packet it receives, rather than each packet
+scattering into a slot.
 """
 
 from __future__ import annotations
@@ -22,6 +28,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import K_NONE, NP
+from shadow1_tpu.core.dense import first_true, get_col, onehot_col
 
 I64_MAX = jnp.iinfo(jnp.int64).max
 
@@ -57,18 +64,14 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
     Returns (buf, overflow_mask). Overflowing events are dropped and must be
     surfaced as a metric — capacity is an experiment knob (SURVEY §7.3.2).
     """
-    h = jnp.arange(buf.time.shape[0])
-    free = buf.kind == K_NONE
-    has_free = free.any(axis=1)
-    slot = jnp.argmax(free, axis=1)
+    has_free, first = first_true(buf.kind == K_NONE)
     ok = mask & has_free
-    # Out-of-range slot index + mode="drop" implements the write mask.
-    slot = jnp.where(ok, slot, buf.time.shape[1])
+    w = first & ok[:, None]
     buf = buf._replace(
-        time=buf.time.at[h, slot].set(time, mode="drop"),
-        tb=buf.tb.at[h, slot].set(buf.self_ctr, mode="drop"),
-        kind=buf.kind.at[h, slot].set(kind, mode="drop"),
-        p=buf.p.at[h, slot].set(p, mode="drop"),
+        time=jnp.where(w, jnp.asarray(time, jnp.int64)[..., None], buf.time),
+        tb=jnp.where(w, buf.self_ctr[:, None], buf.tb),
+        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[..., None], buf.kind),
+        p=jnp.where(w[..., None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
         self_ctr=buf.self_ctr + ok.astype(jnp.int64),
     )
     return buf, mask & ~has_free
@@ -76,7 +79,6 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
 
 def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
     """Per-host pop of the minimum-(time, tb) event with time < until."""
-    h = jnp.arange(buf.time.shape[0])
     elig = (buf.kind != K_NONE) & (buf.time < until)
     t_masked = jnp.where(elig, buf.time, I64_MAX)
     min_t = t_masked.min(axis=1)
@@ -87,13 +89,13 @@ def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
     ev = Popped(
         mask=mask,
         time=jnp.where(mask, min_t, 0),
-        kind=jnp.where(mask, buf.kind[h, slot], K_NONE),
-        p=jnp.where(mask[:, None], buf.p[h, slot], 0),
+        kind=jnp.where(mask, get_col(buf.kind, slot), K_NONE),
+        p=jnp.where(mask[:, None], get_col(buf.p, slot), 0),
     )
-    slot = jnp.where(mask, slot, buf.time.shape[1])
+    sel = onehot_col(slot, buf.time.shape[1], mask)
     buf = buf._replace(
-        kind=buf.kind.at[h, slot].set(K_NONE, mode="drop"),
-        time=buf.time.at[h, slot].set(I64_MAX, mode="drop"),
+        kind=jnp.where(sel, K_NONE, buf.kind),
+        time=jnp.where(sel, I64_MAX, buf.time),
     )
     return buf, ev
 
@@ -103,36 +105,37 @@ def any_eligible(buf: EventBuf, until) -> jnp.ndarray:
 
 
 def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf, jnp.ndarray]:
-    """Scatter N externally-created events into their hosts' buffers.
+    """Merge N externally-created events into their hosts' buffers.
 
-    This is the tensor analogue of the reference's locked cross-thread event
-    push (src/main/utility/async-priority-queue.c): sort by destination, rank
-    within each destination segment, and write each event into its host's
-    r-th free slot. All (dst, slot) targets are distinct by construction, so
-    the scatter is conflict-free. Returns (buf, n_overflow).
+    The tensor analogue of the reference's locked cross-thread event push
+    (src/main/utility/async-priority-queue.c), restructured gather-style for
+    TPU: sort packets by destination (masked ones to the end), then each
+    host's r-th free slot *gathers* the r-th packet of its segment
+    (seg_start[h] + r). All reads are sorted gathers; the only writes are
+    dense ``where``s. Packet r per host is the r-th in flat source order
+    (stable sort), and free slots fill in ascending slot index — identical
+    order to the reference's eager push. Returns (buf, n_overflow).
     """
     n_hosts, cap = buf.time.shape
     n = dst.shape[0]
-    order = jnp.argsort(jnp.where(mask, dst, n_hosts), stable=True)
-    dst_s = dst[order]
-    mask_s = mask[order]
-    # Rank within destination segment.
-    idx = jnp.arange(n)
-    is_start = jnp.concatenate([jnp.array([True]), dst_s[1:] != dst_s[:-1]])
-    seg_start = jnp.maximum.accumulate(jnp.where(is_start, idx, 0))
-    rank = idx - seg_start
-    # r-th free slot per host: sort slots so free ones come first.
-    free = buf.kind == K_NONE
-    free_cnt = free.sum(axis=1)
-    slot_order = jnp.argsort(~free, axis=1, stable=True)  # [H, C], free slots first
-    ok = mask_s & (rank < free_cnt[jnp.where(mask_s, dst_s, 0)])
-    slot = slot_order[jnp.where(ok, dst_s, 0), jnp.minimum(rank, cap - 1)]
-    d = jnp.where(ok, dst_s, n_hosts)
-    s = jnp.where(ok, slot, cap)
+    key = jnp.where(mask, dst, n_hosts).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    dst_s = key[order]
+    hs = jnp.arange(n_hosts, dtype=jnp.int32)
+    seg_start = jnp.searchsorted(dst_s, hs, side="left")
+    seg_end = jnp.searchsorted(dst_s, hs, side="right")
+    n_in = (seg_end - seg_start).astype(jnp.int32)          # [H]
+    free = buf.kind == K_NONE                               # [H, C]
+    free_rank = (jnp.cumsum(free, axis=1) - free).astype(jnp.int32)
+    take = free & (free_rank < n_in[:, None])               # slot receives one
+    src = jnp.minimum(seg_start[:, None] + free_rank, n - 1)
+    oidx = order[src]                                       # [H, C] flat index
     buf = buf._replace(
-        time=buf.time.at[d, s].set(time[order], mode="drop"),
-        tb=buf.tb.at[d, s].set(tb[order], mode="drop"),
-        kind=buf.kind.at[d, s].set(kind[order], mode="drop"),
-        p=buf.p.at[d, s].set(p[order], mode="drop"),
+        time=jnp.where(take, time[oidx], buf.time),
+        tb=jnp.where(take, tb[oidx], buf.tb),
+        kind=jnp.where(take, kind[oidx], buf.kind),
+        p=jnp.where(take[..., None], p[oidx], buf.p),
     )
-    return buf, (mask_s & ~ok).sum()
+    free_cnt = free.sum(axis=1, dtype=jnp.int32)
+    n_over = mask.sum() - jnp.minimum(n_in, free_cnt).sum()
+    return buf, n_over

@@ -16,6 +16,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import NP
+from shadow1_tpu.core.dense import set_col
 
 
 class Outbox(NamedTuple):
@@ -48,18 +49,17 @@ def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.n
     """Append one packet per host where ``mask``. Returns (ob, ok_mask).
 
     Callers that cannot tolerate drops (TCP) must check ``outbox_space``
-    first and defer to the next window instead (K_TX_RESUME).
+    first and defer to the next window instead (K_TX_RESUME). Dense one-hot
+    write — no scatter (core/dense.py).
     """
-    h = jnp.arange(ob.dst.shape[0])
     cap = ob.dst.shape[1]
     ok = mask & (ob.cnt < cap)
-    slot = jnp.where(ok, ob.cnt, cap)
     ob = ob._replace(
-        dst=ob.dst.at[h, slot].set(dst, mode="drop"),
-        kind=ob.kind.at[h, slot].set(kind, mode="drop"),
-        depart=ob.depart.at[h, slot].set(depart, mode="drop"),
-        ctr=ob.ctr.at[h, slot].set(ob.pkt_ctr, mode="drop"),
-        p=ob.p.at[h, slot].set(p, mode="drop"),
+        dst=set_col(ob.dst, ob.cnt, dst, ok),
+        kind=set_col(ob.kind, ob.cnt, kind, ok),
+        depart=set_col(ob.depart, ob.cnt, depart, ok),
+        ctr=set_col(ob.ctr, ob.cnt, ob.pkt_ctr, ok),
+        p=set_col(ob.p, ob.cnt, p, ok),
         cnt=ob.cnt + ok.astype(jnp.int32),
         pkt_ctr=ob.pkt_ctr + ok.astype(jnp.int64),
     )

@@ -39,6 +39,11 @@ class CpuEngine:
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
         self.draws = DrawCache(exp.seed)
+        # Loss decisions are integer-threshold compares on the raw bits
+        # (backend-exact; mirrors route_outbox in core/engine.py).
+        from shadow1_tpu import rng as _rng
+
+        self.loss_thr = _rng.prob_threshold(exp.loss_vv)
         h = exp.n_hosts
         self.heap: list[tuple] = []  # (time, tb, gseq, host, kind, p)
         self._gseq = 0
@@ -99,7 +104,7 @@ class CpuEngine:
         self.metrics["pkts_sent"] += 1
         vs = int(self.exp.host_vertex[src])
         vd = int(self.exp.host_vertex[dst])
-        if self.draws.uniform(R_LOSS, src, ctr) < float(self.exp.loss_vv[vs, vd]):
+        if int(self.draws.bits(R_LOSS, src, ctr)) < int(self.loss_thr[vs, vd]):
             self.metrics["pkts_lost"] += 1
             return True
         arrival = depart + int(self.exp.lat_vv[vs, vd])

@@ -48,10 +48,6 @@ class DrawCache:
             self._xf[k] = got
         return got
 
-    def uniform(self, purpose: int, host: int, ctr: int) -> float:
-        blk = self._xf_block(("u",), purpose, host, ctr, rng.uniform01)
-        return float(blk[ctr % _BLOCK])
-
     def exponential_ns(self, purpose: int, host: int, ctr: int, mean_ns: float) -> int:
         blk = self._xf_block(
             ("e", mean_ns), purpose, host, ctr, lambda b: rng.exponential_ns(b, mean_ns)

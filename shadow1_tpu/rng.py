@@ -2,13 +2,29 @@
 
 The reference gives every host its own seeded RNG (src/main/host/host.c) so
 results are independent of worker scheduling. We go one step further: every
-draw is a pure function of ``(seed, purpose, host, counter)`` via Threefry
-``fold_in`` — order-independent, so the eager CPU oracle and the batched TPU
-engine produce bit-identical streams no matter when each computes its draws.
+draw is a pure function of ``(seed, purpose, host, counter)`` — order
+independent, so the eager CPU oracle and the batched TPU engine produce
+bit-identical streams no matter when each computes its draws.
 
-All transforms from raw bits to values use minimal float chains (a single
-multiply, or log+multiply) to keep eager-vs-jit rounding identical; the
-parity tests in tests/ are the guard.
+Backend-exactness (round-2 postmortem): the original implementation used
+Threefry ``fold_in`` chains plus a float ``log1p`` transform; the float
+transcendental evaluates differently on the axon TPU than on CPU, silently
+breaking the determinism invariant on the target hardware (142,577 vs
+142,576 events over the same 50-window program). Every transform here is
+now **pure integer arithmetic** (or a single IEEE-exact f64 round for the
+mean scaling), identical on every XLA backend by construction:
+
+* ``bits`` — a splitmix64-style avalanche hash of the packed
+  (seed, purpose, host, ctr) tuple: ~10 u64 ops instead of 3 chained
+  Threefry blocks (~8x cheaper on the hot path, and elementwise — no vmap).
+* ``exponential_ns`` — fixed-point −ln(1−u) via count-leading-zeros + a
+  4096-entry Q32 log2 table with linear interpolation (relative error
+  ~1e-7), times an integer-rounded mean.
+* ``uniform_lt`` — probability compares as an integer threshold on the raw
+  bits, never a float comparison.
+
+The DieHarder-grade quality of the splitmix64 finalizer is far beyond what
+a DES needs (the reference uses GLib's Mersenne/rand per host).
 """
 
 from __future__ import annotations
@@ -17,38 +33,115 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_U64 = jnp.uint64
+
+# splitmix64 finalizer constants (public domain, Stafford mix13).
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+# Odd multipliers decorrelating the (purpose, host, ctr) lanes.
+_P1 = np.uint64(0x9E3779B97F4A7C15)  # golden-ratio increment
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+
 
 def base_key(seed: int) -> jax.Array:
-    return jax.random.PRNGKey(np.uint32(seed))
+    """The per-experiment key: a u64 scalar derived from the seed."""
+    z = (int(seed) * 0x9E3779B97F4A7C15 + 0x94D049BB133111EB) & ((1 << 64) - 1)
+    return jnp.asarray(np.uint64(z), _U64)
 
 
-def _key(seed_key: jax.Array, purpose, host, ctr) -> jax.Array:
-    k = jax.random.fold_in(seed_key, purpose)
-    k = jax.random.fold_in(k, host)
-    return jax.random.fold_in(k, ctr)
+def _mix(z):
+    z = z ^ (z >> np.uint64(30))
+    z = z * _C1
+    z = z ^ (z >> np.uint64(27))
+    z = z * _C2
+    z = z ^ (z >> np.uint64(31))
+    return z
 
 
 def bits(seed_key, purpose, host, ctr) -> jax.Array:
-    """One u32 of raw randomness for (purpose, host, ctr). Scalar in, scalar out."""
-    return jax.random.bits(_key(seed_key, purpose, host, ctr), (), jnp.uint32)
+    """One u32 of raw randomness for (purpose, host, ctr).
+
+    Elementwise over any broadcastable host/ctr shapes (u64 wraparound
+    arithmetic; exact on every backend)."""
+    z = (
+        jnp.asarray(seed_key, _U64)
+        + jnp.asarray(purpose, _U64) * _P1
+        + jnp.asarray(host, jnp.int64).astype(_U64) * _P2
+        + jnp.asarray(ctr, jnp.int64).astype(_U64) * _P3
+    )
+    z = _mix(_mix(z))
+    return (z >> np.uint64(32)).astype(jnp.uint32)
 
 
-# Vectorized over (host, ctr) arrays — used by the TPU engine.
-bits_v = jax.vmap(bits, in_axes=(None, None, 0, 0))
+# Historical alias: the Threefry version needed an explicit vmap; the hash is
+# natively vectorized. Signature: (key, purpose, host[H], ctr[H]) -> u32 [H].
+bits_v = bits
 
 
 def uniform01(b: jax.Array) -> jax.Array:
-    """u32 bits → float32 in [0, 1). Single exact multiply."""
+    """u32 bits → float32 in [0, 1). Single exact multiply (display/summary
+    use only — probability *decisions* must use uniform_lt)."""
     return b.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+
+
+def prob_threshold(p) -> np.ndarray:
+    """Probability (numpy array/scalar, host-side) → u64 threshold such that
+    ``bits < threshold`` occurs with probability p (exact at 2^-32)."""
+    return (np.round(np.asarray(p, np.float64) * 2.0 ** 32)).astype(np.uint64)
+
+
+def uniform_lt(b: jax.Array, threshold) -> jax.Array:
+    """Integer Bernoulli: True with probability threshold / 2^32."""
+    return b.astype(_U64) < jnp.asarray(threshold, _U64)
+
+
+# --- fixed-point −ln(1−u) ---------------------------------------------------
+# x = 2^32 − b ∈ [1, 2^32] is uniform; −ln(x/2^32) = (32 − log2 x)·ln2.
+# log2 x = k + log2(1+f) with k = floor(log2 x): table the fraction in Q32.
+_LOG_BITS = 12
+# Kept as numpy so importing this module never initializes a JAX backend
+# (platform probing must run first; see shadow1_tpu.platform). jnp.asarray
+# inside the traced function embeds it as a compile-time constant.
+_LOG_TBL_NP = np.round(
+    np.log2(1.0 + np.arange(2 ** _LOG_BITS + 1) / 2 ** _LOG_BITS) * 2.0 ** 32
+).astype(np.uint64)
+_LN2_Q32 = np.uint64(round(np.log(2.0) * 2 ** 32))
+
+
+def _neg_log1m_q32(b: jax.Array) -> jax.Array:
+    """u32 bits → Q32 fixed-point −ln(1 − b/2^32), exact integer pipeline."""
+    x = (np.uint64(1) << np.uint64(32)) - b.astype(_U64)   # [1, 2^32]
+    k = np.uint64(63) - jax.lax.clz(x.astype(jnp.int64)).astype(_U64)
+    m = x << (np.uint64(63) - k)                            # top bit at 63
+    frac = (m << np.uint64(1)) >> np.uint64(1)              # low 63 = fraction
+    idx = (frac >> np.uint64(63 - _LOG_BITS)).astype(jnp.int32)
+    rem = (frac >> np.uint64(63 - _LOG_BITS - 24)) & np.uint64((1 << 24) - 1)
+    tbl = jnp.asarray(_LOG_TBL_NP, _U64)
+    lo = tbl[idx]
+    hi = tbl[idx + 1]
+    log2_frac_q32 = lo + (((hi - lo) * rem) >> np.uint64(24))
+    log2_x_q32 = (k << np.uint64(32)) + log2_frac_q32
+    e2_q32 = (np.uint64(32) << np.uint64(32)) - log2_x_q32  # (32 − log2 x)
+    # × ln2: split to avoid u64 overflow (e2 ≤ 32·2^32 = 2^37).
+    return (e2_q32 >> np.uint64(16)) * (_LN2_Q32 >> np.uint64(10)) >> np.uint64(6)
 
 
 def exponential_ns(b: jax.Array, mean_ns) -> jax.Array:
     """u32 bits → int64 ns exponential with the given mean.
 
-    Uses -mean * log1p(-u); clamped to ≥ 1 ns so events always advance time.
-    """
-    u = uniform01(b)
-    d = -jnp.float32(mean_ns) * jnp.log1p(-u)
+    Integer pipeline: Q32 −ln(1−u) times the rounded mean; clamped to ≥1 ns
+    so events always advance time. The mean scaling is one f64 multiply +
+    round (IEEE-exact, backend-identical); everything else is integer."""
+    e_q32 = _neg_log1m_q32(b)
+    mean = jnp.round(jnp.asarray(mean_ns, jnp.float64)).astype(_U64)
+    # d = mean · e / 2^32, computed as (mean · (e >> 12)) >> 20 to keep the
+    # product under 2^64 for means up to 2^38 ns (~4.6 min) and e ≤ 22.2.
+    # Means are clamped to that bound (a mean think/delay above 4.6 simulated
+    # minutes is outside any ladder config; the clamp keeps the integer
+    # pipeline overflow-free rather than silently wrapping).
+    mean = jnp.minimum(mean, np.uint64(1) << np.uint64(38))
+    d = (mean * (e_q32 >> np.uint64(12))) >> np.uint64(20)
     return jnp.maximum(d.astype(jnp.int64), 1)
 
 

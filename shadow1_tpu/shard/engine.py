@@ -127,6 +127,7 @@ class ShardedEngine:
         key = self.global_ctx.key
         lat_vv = self.global_ctx.lat_vv
         loss_vv = self.global_ctx.loss_vv
+        loss_thr_vv = self.global_ctx.loss_thr_vv
         host_vertex = self.global_ctx.host_vertex  # full, replicated
         hosts_g = self.global_ctx.hosts
         bw_up_g = self.global_ctx.bw_up
@@ -146,6 +147,7 @@ class ShardedEngine:
                 bw_dn=bw_dn,
                 model_cfg=exp.model_cfg,
                 hosts=hosts,
+                loss_thr_vv=loss_thr_vv,
             )
             handlers = model.make_handlers(ctx)
 

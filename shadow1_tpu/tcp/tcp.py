@@ -64,6 +64,7 @@ from shadow1_tpu.consts import (  # noqa: F811 — shared tuning/state sets
     TCP_RCV_STATES,
     TCP_SENDABLE_STATES,
 )
+from shadow1_tpu.core.dense import get_col, onehot_col, set_col
 from shadow1_tpu.core.outbox import outbox_append, outbox_space
 from shadow1_tpu.net.nic import tx_stamp
 
@@ -105,14 +106,11 @@ class Sock:
         self.mask = mask
 
     def g(self, k):
-        return self.d[k].at[self.h, jnp.where(self.mask, self.sock, 0)].get()
+        return get_col(self.d[k], jnp.where(self.mask, self.sock, 0))
 
     def s(self, k, val, where=None):
         m = self.mask if where is None else (self.mask & where)
-        sk = jnp.where(m, self.sock, self.S)
-        self.d[k] = self.d[k].at[self.h, sk].set(
-            jnp.asarray(val, self.d[k].dtype), mode="drop"
-        )
+        self.d[k] = set_col(self.d[k], self.sock, val, m)
 
 
 class Notif(NamedTuple):
@@ -352,14 +350,16 @@ def tcp_send(st, ctx, mask, sock, nbytes, meta, now):
     has_free = ~mqv.all(axis=1)
     slot = jnp.argmin(mqv, axis=1)
     ok = want_meta & has_free
-    hh = jnp.arange(ctx.n_hosts)
-    sl = jnp.where(ok, slot, mqv.shape[1])
-    mq_valid = r.d["mq_valid"]
-    # [H, S, MQ] scatter at (h, sock, slot)
-    sk = jnp.where(ok, r.sock, r.S)
-    r.d["mq_valid"] = r.d["mq_valid"].at[hh, sk, sl].set(True, mode="drop")
-    r.d["mq_end"] = r.d["mq_end"].at[hh, sk, sl].set(new_end, mode="drop")
-    r.d["mq_meta"] = r.d["mq_meta"].at[hh, sk, sl].set(jnp.asarray(meta, jnp.int32), mode="drop")
+    # Dense (h, sock, slot) one-hot write — no 3D scatter (core/dense.py).
+    sel = (
+        onehot_col(r.sock, r.S, ok)[:, :, None]
+        & onehot_col(slot, mqv.shape[1])[:, None, :]
+    )
+    r.d["mq_valid"] = r.d["mq_valid"] | sel
+    r.d["mq_end"] = jnp.where(sel, new_end[:, None, None], r.d["mq_end"])
+    r.d["mq_meta"] = jnp.where(
+        sel, jnp.asarray(meta, jnp.int32)[:, None, None], r.d["mq_meta"]
+    )
     st = st._replace(model=st.model._replace(tcp=r.d))
     st = tcp_flush(st, ctx, mask & (accepted > 0), sock, now)
     return st, accepted
