@@ -7,15 +7,22 @@ CPU reference engine, and prints ONE JSON line:
     {"metric": "phold_events_per_sec", "value": N, "unit": "events/s",
      "vs_baseline": tpu_events_per_sec / cpu_engine_events_per_sec, ...}
 
-Robustness contract (round-1 postmortem): this script ALWAYS prints exactly
-one JSON line on stdout. The accelerator backend is probed in a subprocess
-with a deadline (shadow1_tpu.platform); if it is down or hangs, the batched
-engine runs on the forced-CPU platform and the ``backend`` field labels that
-honestly. Any unexpected failure still emits a JSON line with an ``error``
-detail instead of a stack trace.
+Robustness contract (round-1/2 postmortems):
+* ALWAYS exactly one JSON line on stdout.
+* The accelerator is probed in a subprocess with a deadline
+  (shadow1_tpu.platform) before any in-process backend init.
+* The timed loop runs in CHUNKS of <=50 windows via ckpt.run_chunked — no
+  single device program runs for minutes (the round-2 fault was one
+  monolithic 2000-window XLA program; 50-window programs complete in
+  seconds on this chip).
+* On a runtime fault the run retries at half scale, and finally on the
+  forced-CPU platform — a measurement is always produced and ``backend``
+  labels it honestly; compile time is reported separately from timed walls.
 
 The CPU comparator is this repo's own reference engine (BASELINE.md: no
-external numbers exist in-environment).
+external numbers exist in-environment), measured on a smaller host count
+(the eager oracle is O(events) Python; PHOLD cost/event is scale-stable) —
+see ``detail.cpu_engine`` for its exact config.
 """
 
 from __future__ import annotations
@@ -23,71 +30,129 @@ from __future__ import annotations
 import json
 import time
 
+# Benchmark workload: dense PHOLD at TPU-native scale (classic PHOLD uses
+# ~10+ live events per LP; denser windows amortize the per-round fixed cost
+# across more hosts — that IS the engine's design point).
+N_HOSTS = 65536
+INIT_EVENTS = 16
+MEAN_DELAY_MS = 2
+WINDOW_MS = 1
+SIM_WINDOWS = 500
+CHUNK = 50
 
-def run_bench() -> dict:
-    import jax
+CPU_HOSTS = 1024
+CPU_WINDOWS = 2
 
+
+def _experiment(n_hosts: int, windows: int):
     from shadow1_tpu.config.compiled import single_vertex_experiment
-    from shadow1_tpu.consts import MS, SEC, EngineParams
-    from shadow1_tpu.core.engine import Engine
-    from shadow1_tpu.cpu_engine import CpuEngine
+    from shadow1_tpu.consts import MS
 
-    n_hosts = 4096
-    mean_delay = 2 * MS
-    window = 1 * MS
-    sim_seconds = 2
-    exp = single_vertex_experiment(
+    return single_vertex_experiment(
         n_hosts=n_hosts,
         seed=1234,
-        end_time=sim_seconds * SEC,
-        latency_ns=window,
+        end_time=windows * WINDOW_MS * MS,
+        latency_ns=WINDOW_MS * MS,
         model="phold",
-        model_cfg={"mean_delay_ns": float(mean_delay), "init_events": 2},
+        model_cfg={"mean_delay_ns": float(MEAN_DELAY_MS * MS), "init_events": INIT_EVENTS},
     )
-    params = EngineParams(ev_cap=32, outbox_cap=32, max_rounds=64)
 
-    eng = Engine(exp, params)
-    # Warm-up at the FULL window count: n_windows is a jit static arg, so the
-    # timed call below must reuse this exact compiled program.
+
+def _params():
+    from shadow1_tpu.consts import EngineParams
+
+    return EngineParams(ev_cap=48, outbox_cap=24, max_rounds=128)
+
+
+def run_tpu(n_hosts: int, windows: int) -> dict:
+    import jax
+
+    from shadow1_tpu import ckpt
+    from shadow1_tpu.consts import SEC
+    from shadow1_tpu.core.engine import Engine
+
+    eng = Engine(_experiment(n_hosts, windows), _params())
+    # Compile both chunk sizes (full chunk + any ragged tail) before timing.
     t0 = time.perf_counter()
-    st = eng.run()
-    jax.block_until_ready(st)
+    warm = eng.run(eng.init_state(), n_windows=CHUNK)
+    tail = windows % CHUNK
+    if tail:
+        warm = eng.run(eng.init_state(), n_windows=tail)
+    jax.block_until_ready(warm)
     compile_wall = time.perf_counter() - t0
+
+    chunk_walls: list[float] = []
+    last = time.perf_counter()
+
+    def on_chunk(st, done):
+        nonlocal last
+        jax.block_until_ready(st)
+        now = time.perf_counter()
+        chunk_walls.append(now - last)
+        last = now
+
     t0 = time.perf_counter()
-    st = eng.run()
+    st = ckpt.run_chunked(eng, n_windows=windows, chunk=CHUNK, on_chunk=on_chunk)
     jax.block_until_ready(st)
-    tpu_wall = time.perf_counter() - t0
+    wall = time.perf_counter() - t0
     m = Engine.metrics_dict(st)
-    tpu_eps = m["events"] / tpu_wall
-
-    # CPU oracle on a slice of the sim (it is >10x slower; extrapolating
-    # events/sec from 10% of the windows is fair — PHOLD is stationary).
-    cpu = CpuEngine(exp, params)
-    cpu_windows = max(1, eng.n_windows // 10)
-    t0 = time.perf_counter()
-    cm = cpu.run(n_windows=cpu_windows)
-    cpu_wall = time.perf_counter() - t0
-    cpu_eps = cm["events"] / cpu_wall
-
-    sim_per_wall = (eng.n_windows * exp.window / SEC) / tpu_wall
     return {
-        "metric": "phold_events_per_sec",
-        "value": round(tpu_eps, 1),
-        "unit": "events/s",
-        "vs_baseline": round(tpu_eps / cpu_eps, 3),
-        "detail": {
-            "n_hosts": n_hosts,
-            "events": m["events"],
-            "tpu_wall_s": round(tpu_wall, 3),
-            "compile_plus_first_run_s": round(compile_wall, 3),
-            "sim_sec_per_wall_sec": round(sim_per_wall, 3),
-            "cpu_engine_events_per_sec": round(cpu_eps, 1),
-            "backend": jax.default_backend(),
-            "device": str(jax.devices()[0]),
-            "ev_overflow": m["ev_overflow"],
-            "ob_overflow": m["ob_overflow"],
-        },
+        "events": m["events"],
+        "wall_s": wall,
+        "events_per_sec": m["events"] / wall,
+        "sim_sec_per_wall_sec": (windows * WINDOW_MS / 1000.0) / wall,
+        "compile_wall_s": compile_wall,
+        "n_chunks": len(chunk_walls),
+        "chunk_wall_min_s": min(chunk_walls),
+        "chunk_wall_max_s": max(chunk_walls),
+        "ev_overflow": m["ev_overflow"],
+        "ob_overflow": m["ob_overflow"],
+        "rounds_per_window": m["rounds"] / max(m["windows"], 1),
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "n_hosts": n_hosts,
+        "windows": windows,
     }
+
+
+def run_cpu_oracle() -> dict:
+    from shadow1_tpu.cpu_engine import CpuEngine
+
+    cpu = CpuEngine(_experiment(CPU_HOSTS, CPU_WINDOWS), _params())
+    t0 = time.perf_counter()
+    cm = cpu.run(n_windows=CPU_WINDOWS)
+    wall = time.perf_counter() - t0
+    return {
+        "n_hosts": CPU_HOSTS,
+        "windows": CPU_WINDOWS,
+        "events": cm["events"],
+        "wall_s": wall,
+        "events_per_sec": cm["events"] / wall,
+    }
+
+
+def _run_cpu_subprocess(n_hosts: int, windows: int) -> dict:
+    """Last-resort rung: re-exec this script with the CPU platform forced
+    BEFORE backend init (an in-process ``jax.config.update`` after a TPU
+    attempt is a no-op — the backend is cached)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, __file__, "--cpu-child", str(n_hosts), str(windows)],
+        capture_output=True, text=True, timeout=1200,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"cpu-child rc={out.returncode}: {out.stderr[-500:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _cpu_child(n_hosts: int, windows: int) -> None:
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import force_cpu
+
+    force_cpu()
+    print(json.dumps(run_tpu(n_hosts, windows)))
 
 
 def main() -> None:
@@ -96,9 +161,50 @@ def main() -> None:
         import shadow1_tpu  # noqa: F401  (x64 on, before jax arrays exist)
         from shadow1_tpu.platform import ensure_live_platform, probe_default_backend
 
-        ensure_live_platform(min_devices=1)
+        backend = ensure_live_platform(min_devices=1)
         probe = probe_default_backend()
-        result = run_bench()
+
+        if backend == "cpu":
+            # Probe already forced CPU: go straight to the CPU-scale config —
+            # the TPU-scale workload would crawl for hours on this backend.
+            ladder = ((N_HOSTS // 8, SIM_WINDOWS // 2, False),)
+        else:
+            ladder = (
+                (N_HOSTS, SIM_WINDOWS, False),
+                (N_HOSTS // 2, SIM_WINDOWS // 2, False),
+                (N_HOSTS // 8, SIM_WINDOWS // 2, True),
+            )
+        attempts = []
+        tpu = None
+        for n_hosts, windows, cpu_sub in ladder:
+            try:
+                if cpu_sub:
+                    tpu = _run_cpu_subprocess(n_hosts, windows)
+                else:
+                    tpu = run_tpu(n_hosts, windows)
+                break
+            except Exception as e:  # noqa: BLE001 — fall down the ladder
+                attempts.append(
+                    {"n_hosts": n_hosts, "windows": windows,
+                     "cpu_subprocess": cpu_sub, "error": repr(e)[:300]}
+                )
+        if tpu is None:
+            raise RuntimeError(f"all bench attempts failed: {attempts}")
+
+        cpu = run_cpu_oracle()
+        result = {
+            "metric": "phold_events_per_sec",
+            "value": round(tpu["events_per_sec"], 1),
+            "unit": "events/s",
+            "vs_baseline": round(tpu["events_per_sec"] / cpu["events_per_sec"], 3),
+            "detail": {
+                **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in tpu.items()},
+                "cpu_engine": {
+                    k: (round(v, 4) if isinstance(v, float) else v) for k, v in cpu.items()
+                },
+                "failed_attempts": attempts,
+            },
+        }
         if probe.get("error"):
             result["detail"]["backend_probe_error"] = probe["error"]
     except Exception as e:  # noqa: BLE001 — the JSON line must always print
@@ -116,4 +222,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) == 4 and sys.argv[1] == "--cpu-child":
+        _cpu_child(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        main()

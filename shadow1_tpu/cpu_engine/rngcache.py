@@ -1,15 +1,16 @@
 """Block-cached counter RNG draws for the CPU oracle.
 
-The oracle consumes draws one at a time; issuing one eager JAX call per draw
-would dominate its runtime. Draws are pure functions of (purpose, host,
-counter), so we batch-compute blocks of consecutive counters with the exact
-same jnp transforms the TPU engine traces (shadow1_tpu.rng) and cache them —
-bit-identical values, amortized dispatch.
+The oracle consumes draws one at a time. Draws are pure functions of
+(purpose, host, counter); since the shared RNG (shadow1_tpu.rng) is pure
+integer arithmetic, the oracle evaluates its exact NumPy twins — zero
+device dispatch (an eager jnp call per block was a device roundtrip and
+dominated oracle runtime when the default backend was the TPU), bit-
+identical values by construction (guarded by tests/test_rng.py). Blocks of
+consecutive counters are still cached to amortize the vectorized hash.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 import numpy as np
 
 from shadow1_tpu import rng
@@ -19,7 +20,7 @@ _BLOCK = 256
 
 class DrawCache:
     def __init__(self, seed: int):
-        self.key = rng.base_key(seed)
+        self.key = rng.base_key_np(seed)
         self._bits: dict[tuple, np.ndarray] = {}
         self._xf: dict[tuple, np.ndarray] = {}  # transformed-value blocks
 
@@ -27,9 +28,8 @@ class DrawCache:
         k = (purpose, host, blk)
         got = self._bits.get(k)
         if got is None:
-            ctrs = jnp.arange(blk * _BLOCK, (blk + 1) * _BLOCK)
-            hosts = jnp.full(_BLOCK, host)
-            got = np.asarray(rng.bits_v(self.key, purpose, hosts, ctrs))
+            ctrs = np.arange(blk * _BLOCK, (blk + 1) * _BLOCK, dtype=np.int64)
+            got = rng.bits_np(self.key, purpose, np.int64(host), ctrs)
             self._bits[k] = got
         return got
 
@@ -37,23 +37,22 @@ class DrawCache:
         return self._bits_block(purpose, host, ctr // _BLOCK)[ctr % _BLOCK]
 
     def _xf_block(self, tag, purpose, host, ctr, fn) -> np.ndarray:
-        """Whole-block transform via the shared jnp code path (one eager call
-        per block instead of one per draw)."""
+        """Whole-block transform (one vectorized call per block)."""
         blk = ctr // _BLOCK
         k = (tag, purpose, host, blk)
         got = self._xf.get(k)
         if got is None:
-            b = jnp.asarray(self._bits_block(purpose, host, blk))
-            got = np.asarray(fn(b))
+            got = fn(self._bits_block(purpose, host, blk))
             self._xf[k] = got
         return got
 
     def exponential_ns(self, purpose: int, host: int, ctr: int, mean_ns: float) -> int:
         blk = self._xf_block(
-            ("e", mean_ns), purpose, host, ctr, lambda b: rng.exponential_ns(b, mean_ns)
+            ("e", mean_ns), purpose, host, ctr,
+            lambda b: rng.exponential_ns_np(b, mean_ns),
         )
         return int(blk[ctr % _BLOCK])
 
     def randint(self, purpose: int, host: int, ctr: int, n: int) -> int:
-        blk = self._xf_block(("r", n), purpose, host, ctr, lambda b: rng.randint(b, n))
+        blk = self._xf_block(("r", n), purpose, host, ctr, lambda b: rng.randint_np(b, n))
         return int(blk[ctr % _BLOCK])

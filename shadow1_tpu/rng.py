@@ -46,8 +46,7 @@ _P3 = np.uint64(0x165667B19E3779F9)
 
 def base_key(seed: int) -> jax.Array:
     """The per-experiment key: a u64 scalar derived from the seed."""
-    z = (int(seed) * 0x9E3779B97F4A7C15 + 0x94D049BB133111EB) & ((1 << 64) - 1)
-    return jnp.asarray(np.uint64(z), _U64)
+    return jnp.asarray(base_key_np(seed), _U64)
 
 
 def _mix(z):
@@ -123,8 +122,9 @@ def _neg_log1m_q32(b: jax.Array) -> jax.Array:
     log2_frac_q32 = lo + (((hi - lo) * rem) >> np.uint64(24))
     log2_x_q32 = (k << np.uint64(32)) + log2_frac_q32
     e2_q32 = (np.uint64(32) << np.uint64(32)) - log2_x_q32  # (32 − log2 x)
-    # × ln2: split to avoid u64 overflow (e2 ≤ 32·2^32 = 2^37).
-    return (e2_q32 >> np.uint64(16)) * (_LN2_Q32 >> np.uint64(10)) >> np.uint64(6)
+    # × ln2 at Q27 (e2 ≤ 2^37, so the product stays under 2^64; ln2's Q27
+    # floor costs ~6e-9 relative — no e2 truncation at all).
+    return (e2_q32 * (_LN2_Q32 >> np.uint64(5))) >> np.uint64(27)
 
 
 def exponential_ns(b: jax.Array, mean_ns) -> jax.Array:
@@ -135,13 +135,16 @@ def exponential_ns(b: jax.Array, mean_ns) -> jax.Array:
     round (IEEE-exact, backend-identical); everything else is integer."""
     e_q32 = _neg_log1m_q32(b)
     mean = jnp.round(jnp.asarray(mean_ns, jnp.float64)).astype(_U64)
-    # d = mean · e / 2^32, computed as (mean · (e >> 12)) >> 20 to keep the
-    # product under 2^64 for means up to 2^38 ns (~4.6 min) and e ≤ 22.2.
-    # Means are clamped to that bound (a mean think/delay above 4.6 simulated
-    # minutes is outside any ladder config; the clamp keeps the integer
-    # pipeline overflow-free rather than silently wrapping).
+    # Means are clamped to 2^38 ns (~4.6 simulated minutes, outside any
+    # ladder config) to keep the integer pipeline overflow-free rather than
+    # silently wrapping.
     mean = jnp.minimum(mean, np.uint64(1) << np.uint64(38))
-    d = (mean * (e_q32 >> np.uint64(12))) >> np.uint64(20)
+    # d = mean · e_q32 / 2^32 via a hi/lo split so nothing overflows u64 and
+    # the only truncation is 7 low bits of the Q32 fraction (~3e-8 of e):
+    # mean·e_hi ≤ 2^38·22.2 and mean·(e_lo>>7) ≤ 2^38·2^25 = 2^63.
+    e_hi = e_q32 >> np.uint64(32)
+    e_lo = e_q32 & np.uint64(0xFFFFFFFF)
+    d = mean * e_hi + ((mean * (e_lo >> np.uint64(7))) >> np.uint64(25))
     return jnp.maximum(d.astype(jnp.int64), 1)
 
 
@@ -150,3 +153,74 @@ def randint(b: jax.Array, n) -> jax.Array:
     for n ≪ 2^32 beyond the standard multiply-shift approximation; identical
     in both engines)."""
     return ((b.astype(jnp.uint64) * jnp.uint64(n)) >> jnp.uint64(32)).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# NumPy twins — bit-exact reimplementations for the eager CPU oracle.
+#
+# Because every transform above is pure integer arithmetic, it has an exact
+# host-side twin (no device dispatch per draw — the oracle used to issue
+# eager jnp calls, each a device roundtrip). tests/test_rng guards
+# jnp-vs-numpy equality draw-for-draw. All constants are np.uint64 to dodge
+# NumPy's uint64+int -> float64 promotion trap.
+# --------------------------------------------------------------------------
+_U64_1 = np.uint64(1)
+
+
+def base_key_np(seed: int) -> np.uint64:
+    z = (int(seed) * 0x9E3779B97F4A7C15 + 0x94D049BB133111EB) & ((1 << 64) - 1)
+    return np.uint64(z)
+
+
+def _mix_np(z):
+    z = z ^ (z >> np.uint64(30))
+    z = z * _C1
+    z = z ^ (z >> np.uint64(27))
+    z = z * _C2
+    z = z ^ (z >> np.uint64(31))
+    return z
+
+
+def bits_np(seed_key: np.uint64, purpose, host, ctr) -> np.ndarray:
+    with np.errstate(over="ignore"):  # u64 wraparound is the point
+        z = (
+            np.uint64(seed_key)
+            + np.uint64(purpose) * _P1
+            + np.asarray(host, np.uint64) * _P2
+            + np.asarray(ctr, np.uint64) * _P3
+        )
+        z = _mix_np(_mix_np(z))
+    return (z >> np.uint64(32)).astype(np.uint32)
+
+
+def _neg_log1m_q32_np(b: np.ndarray) -> np.ndarray:
+    x = (_U64_1 << np.uint64(32)) - b.astype(np.uint64)
+    # floor(log2 x) via frexp (exact: x <= 2^32 is exactly representable).
+    _, e = np.frexp(x.astype(np.float64))
+    k = (e - 1).astype(np.uint64)
+    m = x << (np.uint64(63) - k)
+    frac = (m << _U64_1) >> _U64_1
+    idx = (frac >> np.uint64(63 - _LOG_BITS)).astype(np.int64)
+    rem = (frac >> np.uint64(63 - _LOG_BITS - 24)) & np.uint64((1 << 24) - 1)
+    lo = _LOG_TBL_NP[idx]
+    hi = _LOG_TBL_NP[idx + 1]
+    log2_frac_q32 = lo + (((hi - lo) * rem) >> np.uint64(24))
+    log2_x_q32 = (k << np.uint64(32)) + log2_frac_q32
+    e2_q32 = (np.uint64(32) << np.uint64(32)) - log2_x_q32
+    return (e2_q32 * (_LN2_Q32 >> np.uint64(5))) >> np.uint64(27)
+
+
+def exponential_ns_np(b: np.ndarray, mean_ns) -> np.ndarray:
+    e_q32 = _neg_log1m_q32_np(np.asarray(b))
+    mean = np.round(np.asarray(mean_ns, np.float64)).astype(np.uint64)
+    mean = np.minimum(mean, _U64_1 << np.uint64(38))
+    e_hi = e_q32 >> np.uint64(32)
+    e_lo = e_q32 & np.uint64(0xFFFFFFFF)
+    d = mean * e_hi + ((mean * (e_lo >> np.uint64(7))) >> np.uint64(25))
+    return np.maximum(d.astype(np.int64), 1)
+
+
+def randint_np(b, n) -> np.ndarray:
+    return (
+        (np.asarray(b, np.uint64) * np.uint64(n)) >> np.uint64(32)
+    ).astype(np.int32)
