@@ -146,6 +146,15 @@ def init(ctx, evbuf, tcpd):
     s = ctx.params.sockets_per_host
     ct = int(cfg.get("ct_cap", 64))
     app = {
+        # Per-host config columns live in app state (NOT read from
+        # ctx.model_cfg inside handlers) so they shard with the host axis —
+        # a handler reading a global [n_total] cfg array inside the
+        # shard-local block is a trace-time shape error (round-1 advisor
+        # finding; same pattern as apps/tgen.py).
+        "role": jnp.asarray(cfg["role"], jnp.int32),
+        "cfg_n_streams": jnp.asarray(cfg["n_streams"], jnp.int32),
+        "cfg_mean_cells": jnp.asarray(cfg["mean_stream_cells"], jnp.float32),
+        "cfg_mean_think": jnp.asarray(cfg["mean_think_ns"], jnp.float32),
         # client
         "cl_state": jnp.zeros(h, jnp.int32),
         "cl_guard": jnp.full(h, -1, jnp.int32),
@@ -226,8 +235,7 @@ def _client_begin_circuit(st, ctx, mask, now):
     app["cl_hop"] = jnp.where(mask, 1, app["cl_hop"])
     app["cl_state"] = jnp.where(mask, CL_BUILDING, app["cl_state"])
     app["cl_streams_left"] = jnp.where(
-        mask, jnp.asarray(ctx.model_cfg["n_streams"], jnp.int32),
-        app["cl_streams_left"],
+        mask, app["cfg_n_streams"], app["cl_streams_left"]
     )
     st = st._replace(model=st.model._replace(app=app))
     one = jnp.ones(ctx.n_hosts, jnp.int32)
@@ -239,10 +247,7 @@ def _client_begin_stream(st, ctx, mask, now):
     cells_max = int(ctx.model_cfg.get("cells_max", 120))
     app = dict(st.model.app)
     want = jnp.clip(
-        rng.exponential_ns(
-            _draw_bits(ctx, app, mask),
-            jnp.asarray(ctx.model_cfg["mean_stream_cells"], jnp.float32),
-        ),
+        rng.exponential_ns(_draw_bits(ctx, app, mask), app["cfg_mean_cells"]),
         1, cells_max,
     ).astype(jnp.int32)
     app["cl_cells_want"] = jnp.where(mask, want, app["cl_cells_want"])
@@ -255,10 +260,7 @@ def _client_begin_stream(st, ctx, mask, now):
 
 def _client_think(st, ctx, mask, now):
     app = dict(st.model.app)
-    think = rng.exponential_ns(
-        _draw_bits(ctx, app, mask),
-        jnp.asarray(ctx.model_cfg["mean_think_ns"], jnp.float32),
-    )
+    think = rng.exponential_ns(_draw_bits(ctx, app, mask), app["cfg_mean_think"])
     st = st._replace(model=st.model._replace(app=app))
     return push_local_event(st, ctx, mask, now + think, K_APP, p0=OP_THINK)
 
@@ -460,7 +462,7 @@ def on_wakeup(st, ctx, ev, mask):
 def on_notify(st, ctx, nf: T.Notif, now, mask):
     f = nf.flags
     sock = nf.sock
-    role = jnp.asarray(ctx.model_cfg["role"], jnp.int32)
+    role = st.model.app["role"]
     is_client = role == 1
     est = (f & N_ESTABLISHED) != 0
     msg = (f & N_MSG) != 0

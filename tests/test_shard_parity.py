@@ -57,6 +57,18 @@ def test_phold_sharded_parity():
     assert_same(m1, s1, m8, s8, summary_keys=("hops",))
 
 
+def test_tor_sharded_parity():
+    """The flagship multi-chip workload (rung 4 is sharded Tor): clients,
+    weighted relays and dirauths spread across all 8 shards; every semantic
+    counter and per-host summary must bit-match the single-device engine."""
+    from tests.test_tor_parity import TOR_KEYS, tor_exp
+
+    exp = tor_exp(seed=11, end=30 * SEC)
+    m1, s1, m8, s8 = run_pair(exp, EngineParams(ev_cap=256, sockets_per_host=32))
+    assert int(s1["clients_done"]) == 12  # the workload actually completed
+    assert_same(m1, s1, m8, s8, summary_keys=TOR_KEYS)
+
+
 def test_filexfer_sharded_parity():
     n = 8
     role = np.full(n, 1, np.int64)
