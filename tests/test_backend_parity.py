@@ -1,0 +1,75 @@
+"""Cross-BACKEND determinism: the engine on the real accelerator vs the
+CPU oracle (docs/SEMANTICS.md `Randomness`).
+
+The rest of the suite forces the CPU platform (conftest), so the round-2
+regression — identical programs producing different event counts on the
+TPU than on CPU, via backend-dependent float transcendentals — was
+invisible to it. This test runs the comparison in a SUBPROCESS with the
+default (accelerator) platform: skipped cleanly when no live accelerator
+is reachable within the probe deadline.
+
+VERDICT r2 #5: ≥1k hosts, ≥50 windows, identical counters.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import json
+import shadow1_tpu
+import jax
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+
+exp = single_vertex_experiment(
+    n_hosts=1024, seed=2024, end_time=60 * MS, latency_ns=1 * MS,
+    model="phold", model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 4},
+)
+params = EngineParams(ev_cap=32, outbox_cap=16, max_rounds=64)
+eng = Engine(exp, params)
+st = eng.run()  # 60 windows on the DEFAULT backend (accelerator when alive)
+m = Engine.metrics_dict(st)
+cm = CpuEngine(exp, params).run()
+print(json.dumps({"backend": jax.default_backend(), "tpu": m, "cpu": cm}))
+"""
+
+
+def test_accelerator_vs_oracle_counters():
+    # Undo conftest's CPU-forcing env mutations for the child so it boots
+    # the default accelerator platform. The child run IS the gate: a child
+    # that fails/hangs/lands on CPU means no usable accelerator -> skip
+    # (probing via shadow1_tpu.platform would inherit the conftest env and
+    # could mis-report cpu on machines configured by JAX_PLATFORMS alone).
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    if "XLA_FLAGS" in env:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "", env["XLA_FLAGS"]
+        ).strip()
+        if flags:
+            env["XLA_FLAGS"] = flags
+        else:
+            del env["XLA_FLAGS"]  # whitespace-only XLA_FLAGS is a hard error
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("accelerator backend init/run exceeded 600s — unreachable")
+    if out.returncode != 0:
+        pytest.skip(f"no usable accelerator backend: {out.stderr[-500:]}")
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    if r["backend"] in ("", "cpu"):
+        pytest.skip(f"default backend is {r['backend']!r} — nothing to compare")
+    for k in ("events", "pkts_sent", "pkts_delivered", "pkts_lost",
+              "ev_overflow", "ob_overflow"):
+        assert r["tpu"][k] == r["cpu"][k], (k, r["tpu"][k], r["cpu"][k])
