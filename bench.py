@@ -5,7 +5,12 @@ benchmark, src/test/phold/) on the batched TPU engine and on the sequential
 CPU reference engine, and prints ONE JSON line:
 
     {"metric": "phold_events_per_sec", "value": N, "unit": "events/s",
-     "vs_baseline": tpu_events_per_sec / cpu_engine_events_per_sec, ...}
+     "vs_baseline": tpu_events_per_sec / baseline_events_per_sec, ...}
+
+``vs_baseline`` divides by the honest thread-per-core C++ DES
+(detail.cpp_thread_per_core, SURVEY §7.3.5) run on the same achieved
+config when it builds; else by the interpreted Python oracle
+(detail.python_oracle). ``detail.baseline_kind`` says which.
 
 Robustness contract (round-1/2 postmortems):
 * ALWAYS exactly one JSON line on stdout.
@@ -19,10 +24,9 @@ Robustness contract (round-1/2 postmortems):
   forced-CPU platform — a measurement is always produced and ``backend``
   labels it honestly; compile time is reported separately from timed walls.
 
-The CPU comparator is this repo's own reference engine (BASELINE.md: no
-external numbers exist in-environment), measured on a smaller host count
-(the eager oracle is O(events) Python; PHOLD cost/event is scale-stable) —
-see ``detail.cpu_engine`` for its exact config.
+The Python oracle is measured on a smaller host count (the eager oracle is
+O(events) Python; PHOLD cost/event is scale-stable) — see
+``detail.python_oracle`` for its exact config.
 """
 
 from __future__ import annotations
@@ -131,6 +135,53 @@ def run_cpu_oracle() -> dict:
     }
 
 
+def run_cpp_baseline(n_hosts: int, tpu_windows: int) -> dict | None:
+    """The honest thread-per-core baseline (SURVEY §7.3.5): the C++
+    multi-core DES on the SAME achieved experiment config (counters
+    bit-match the oracle and the TPU engine — tests/test_native_
+    comparator.py). PHOLD is stationary, so 1/5 of the windows gives a
+    stable events/sec. Reported as the best of (one thread per available
+    core, 16 shards) — on a single-core box extra shards still help via
+    smaller, cache-resident heaps, and the baseline should be the CPU's
+    best foot. Each variant fails independently (a timeout in one must not
+    discard the other)."""
+    import os
+
+    try:
+        from shadow1_tpu import native
+
+        native.ensure_built()
+    except Exception as e:  # noqa: BLE001 — no toolchain -> no baseline
+        return {"kind": "cpp_thread_per_core", "error": repr(e)[:300]}
+    windows = max(tpu_windows // 5, 10)
+    variants = []
+    for nt in dict.fromkeys((os.cpu_count() or 1, 16)):
+        try:
+            r = native.run_phold(
+                n_hosts=n_hosts, seed=1234, n_windows=windows,
+                window_ns=WINDOW_MS * 10**6, mean_delay_ns=MEAN_DELAY_MS * 1e6,
+                init_events=INIT_EVENTS, ev_cap=_params().ev_cap,
+                outbox_cap=_params().outbox_cap, n_threads=nt,
+            )
+            variants.append(
+                {"n_threads": nt, "events": r["events"], "wall_s": r["wall_s"],
+                 "events_per_sec": r["events_per_sec"]}
+            )
+        except Exception as e:  # noqa: BLE001 — per-variant best effort
+            variants.append({"n_threads": nt, "error": repr(e)[:300]})
+    ok = [v for v in variants if "events_per_sec" in v]
+    out = {
+        "kind": "cpp_thread_per_core",
+        "n_hosts": n_hosts,
+        "windows": windows,
+        "cpu_cores": os.cpu_count(),
+        "variants": variants,
+    }
+    if ok:
+        out["best"] = max(ok, key=lambda v: v["events_per_sec"])
+    return out
+
+
 def _run_cpu_subprocess(n_hosts: int, windows: int) -> dict:
     """Last-resort rung: re-exec this script with the CPU platform forced
     BEFORE backend init (an in-process ``jax.config.update`` after a TPU
@@ -192,14 +243,25 @@ def main() -> None:
             raise RuntimeError(f"all bench attempts failed: {attempts}")
 
         cpu = run_cpu_oracle()
+        cpp = run_cpp_baseline(tpu["n_hosts"], tpu["windows"])
+        # vs_baseline is against the HONEST thread-per-core C++ DES when it
+        # built and ran; the interpreted Python oracle otherwise (labeled).
+        if cpp and "best" in cpp:
+            base_eps = cpp["best"]["events_per_sec"]
+            base_kind = "cpp_thread_per_core"
+        else:
+            base_eps = cpu["events_per_sec"]
+            base_kind = "python_oracle"
         result = {
             "metric": "phold_events_per_sec",
             "value": round(tpu["events_per_sec"], 1),
             "unit": "events/s",
-            "vs_baseline": round(tpu["events_per_sec"] / cpu["events_per_sec"], 3),
+            "vs_baseline": round(tpu["events_per_sec"] / base_eps, 3),
             "detail": {
                 **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in tpu.items()},
-                "cpu_engine": {
+                "baseline_kind": base_kind,
+                "cpp_thread_per_core": cpp,
+                "python_oracle": {
                     k: (round(v, 4) if isinstance(v, float) else v) for k, v in cpu.items()
                 },
                 "failed_attempts": attempts,

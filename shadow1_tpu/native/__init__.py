@@ -1,0 +1,95 @@
+"""Native thread-per-core comparator: build + run harness.
+
+Builds ``phold_comparator.cpp`` with the system g++ on first use (cached
+under ``build/native/`` at the repo root) and runs it on the same
+experiment parameters the JAX engine and Python oracle consume. The Q32
+log2 table is dumped from shadow1_tpu.rng's numpy source of truth so the
+C++ fixed-point exponential is bit-identical to both engines (no libm
+rounding drift can enter).
+
+This is the honest baseline mandated by BASELINE.json ("thread-per-core
+CPU scheduler", reference scheduler-policy-host-steal.c): an optimized
+multi-core C++ DES, not the interpreted oracle. tests/test_native_
+comparator.py asserts counter equality against the oracle, which is what
+entitles bench.py to use its wall clock as ``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_REPO = _DIR.parent.parent
+_BUILD = _REPO / "build" / "native"
+_BIN = _BUILD / "phold_comparator"
+_TABLE = _BUILD / "log2_q32.tbl"
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _dump_table() -> None:
+    from shadow1_tpu import rng
+
+    tbl = np.asarray(rng._LOG_TBL_NP, np.uint64)
+    assert tbl.shape == (2**rng._LOG_BITS + 1,)
+    with open(_TABLE, "wb") as f:
+        f.write(tbl.tobytes())
+        f.write(np.uint64(rng._LN2_Q32).tobytes())
+
+
+def ensure_built(force: bool = False) -> pathlib.Path:
+    src = _DIR / "phold_comparator.cpp"
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    if force or not _TABLE.exists():
+        _dump_table()
+    if not force and _BIN.exists() and _BIN.stat().st_mtime >= src.stat().st_mtime:
+        return _BIN
+    cmd = ["g++", "-O2", "-std=c++17", "-pthread", "-o", str(_BIN), str(src)]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        raise NativeUnavailable(f"g++ unavailable: {e!r}") from e
+    if out.returncode != 0:
+        raise NativeUnavailable(f"g++ failed: {out.stderr[-800:]}")
+    return _BIN
+
+
+def run_phold(
+    n_hosts: int,
+    seed: int,
+    n_windows: int,
+    window_ns: int,
+    mean_delay_ns: float,
+    init_events: int,
+    ev_cap: int,
+    outbox_cap: int,
+    n_threads: int | None = None,
+    timeout_s: float = 900.0,
+) -> dict:
+    """Run the comparator; returns its counters + wall_s + events_per_sec."""
+    binary = ensure_built()
+    if n_threads is None:
+        n_threads = os.cpu_count() or 1
+    cmd = [
+        str(binary), str(_TABLE), str(n_hosts), str(seed), str(n_windows),
+        str(window_ns), str(int(round(mean_delay_ns))), str(init_events),
+        str(ev_cap), str(outbox_cap), str(n_threads),
+    ]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
+    if out.returncode != 0:
+        raise NativeUnavailable(
+            f"comparator rc={out.returncode}: {out.stderr[-500:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_phold(*map(int, sys.argv[1:]))))

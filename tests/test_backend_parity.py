@@ -23,6 +23,7 @@ _CHILD = r"""
 import json
 import shadow1_tpu
 import jax
+print("BACKEND_UP", jax.default_backend(), flush=True)  # init sentinel
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, EngineParams
 from shadow1_tpu.core.engine import Engine
@@ -57,16 +58,35 @@ def test_accelerator_vs_oracle_counters():
             env["XLA_FLAGS"] = flags
         else:
             del env["XLA_FLAGS"]  # whitespace-only XLA_FLAGS is a hard error
+    cwd = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+    # Cheap liveness probe first (hung backend init is a known failure mode
+    # — platform.py): bounds the dead-accelerator cost to ~60s, not 600s.
+    probe_src = "import jax; print(jax.default_backend(), len(jax.devices()))"
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            capture_output=True, text=True, timeout=60, env=env, cwd=cwd,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("accelerator backend init exceeded 60s probe deadline")
+    if probe.returncode != 0 or probe.stdout.split()[:1] in ([], ["cpu"]):
+        pytest.skip(f"no live accelerator backend: {probe.stdout} {probe.stderr[-300:]}")
     try:
         out = subprocess.run(
             [sys.executable, "-c", _CHILD],
-            capture_output=True, text=True, timeout=600, env=env,
-            cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+            capture_output=True, text=True, timeout=600, env=env, cwd=cwd,
         )
     except subprocess.TimeoutExpired:
-        pytest.skip("accelerator backend init/run exceeded 600s — unreachable")
+        pytest.skip("accelerator backend run exceeded 600s — unreachable")
     if out.returncode != 0:
-        pytest.skip(f"no usable accelerator backend: {out.stderr[-500:]}")
+        if "BACKEND_UP" in out.stdout:
+            # The backend initialized and THEN the engine failed: that is a
+            # backend-specific regression, the very thing this test exists
+            # to catch — fail, don't skip.
+            raise AssertionError(
+                f"engine failed on live accelerator backend:\n{out.stderr[-2000:]}"
+            )
+        pytest.skip(f"accelerator backend failed to initialize: {out.stderr[-500:]}")
     r = json.loads(out.stdout.strip().splitlines()[-1])
     if r["backend"] in ("", "cpu"):
         pytest.skip(f"default backend is {r['backend']!r} — nothing to compare")

@@ -1,0 +1,48 @@
+"""The C++ thread-per-core comparator simulates the identical experiment.
+
+Counter equality against the Python oracle (itself parity-locked to the
+TPU engine) is what entitles bench.py to quote the comparator's wall clock
+as the honest thread-per-core baseline (SURVEY §7.3.5).
+"""
+
+import pytest
+
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.cpu_engine import CpuEngine
+
+native = pytest.importorskip("shadow1_tpu.native")
+
+
+def _config(n_hosts=256, windows=40, init=3):
+    exp = single_vertex_experiment(
+        n_hosts=n_hosts, seed=77, end_time=windows * MS, latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": init},
+    )
+    params = EngineParams(ev_cap=32, outbox_cap=16, max_rounds=64)
+    return exp, params, windows
+
+
+def _run_native(exp, params, windows, n_threads):
+    try:
+        return native.run_phold(
+            n_hosts=exp.n_hosts, seed=exp.seed, n_windows=windows,
+            window_ns=exp.window, mean_delay_ns=exp.model_cfg["mean_delay_ns"],
+            init_events=exp.model_cfg["init_events"], ev_cap=params.ev_cap,
+            outbox_cap=params.outbox_cap, n_threads=n_threads,
+        )
+    except native.NativeUnavailable as e:
+        pytest.skip(str(e))
+
+
+@pytest.mark.parametrize("n_threads", [1, 4])
+def test_native_matches_oracle(n_threads):
+    exp, params, windows = _config()
+    cm = CpuEngine(exp, params).run()
+    assert cm["ev_overflow"] == 0 and cm["ob_overflow"] == 0, (
+        "config must be overflow-free for exact parity"
+    )
+    nm = _run_native(exp, params, windows, n_threads)
+    for k in ("events", "pkts_sent", "pkts_delivered", "ev_overflow", "ob_overflow"):
+        assert nm[k] == cm[k], (k, nm[k], cm[k], f"threads={n_threads}")
