@@ -1,0 +1,162 @@
+"""Run the five-rung BASELINE benchmark ladder and record the results.
+
+    python bench_ladder.py [rung ...] [--windows N] [--json PATH]
+
+For each rung config (configs/rung*.yaml): run the batched engine on the
+default backend (TPU when alive) with chunked timing — compile excluded,
+overflow counters recorded (the parity contract requires them to be 0; a
+nonzero count means the rung's capacity knobs need retuning, and the row
+says so) — and the sequential CPU oracle on a bounded slice of the same
+experiment for the events/sec comparison (the oracle is O(events) Python;
+its slice and the extrapolation basis are recorded in the row).
+
+Output: one JSON line per rung on stdout (plus a human table on stderr),
+and with ``--json`` the rows are also written to a file. BASELINE.md's
+results table is generated from these rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+RUNGS = {
+    "rung1": "configs/rung1_filexfer.yaml",
+    "rung2": "configs/rung2_tgen100.yaml",
+    "rung3": "configs/rung3_tor1k.yaml",
+    "rung4": "configs/rung4_tor10k.yaml",
+    "rung5": "configs/rung5_bitcoin5k.yaml",
+}
+CHUNK = 100
+ORACLE_EVENT_BUDGET = 200_000  # stop the oracle slice near this many events
+
+
+def run_rung(name: str, path: str, windows_override: int | None) -> dict:
+    import jax
+
+    from shadow1_tpu import ckpt
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.consts import SEC
+    from shadow1_tpu.core.engine import Engine
+
+    exp, params, _scheduler = load_experiment(path)
+    eng = Engine(exp, params)
+    total = windows_override or eng.n_windows
+
+    t0 = time.perf_counter()
+    warm_w = min(CHUNK, total)
+    jax.block_until_ready(eng.run(eng.init_state(), n_windows=warm_w))
+    tail = total % CHUNK if total > CHUNK else 0
+    if tail:
+        jax.block_until_ready(eng.run(eng.init_state(), n_windows=tail))
+    compile_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    st = ckpt.run_chunked(eng, n_windows=total, chunk=CHUNK)
+    jax.block_until_ready(st)
+    wall = time.perf_counter() - t0
+    m = Engine.metrics_dict(st)
+    summary = eng.model_summary(st)
+    sim_s = total * exp.window / SEC
+
+    row = {
+        "rung": name,
+        "config": path,
+        "n_hosts": exp.n_hosts,
+        "windows": total,
+        "sim_s": round(sim_s, 3),
+        "backend": jax.default_backend(),
+        "engine": "tpu-batched",
+        "events": m["events"],
+        "events_per_sec": round(m["events"] / wall, 1),
+        "sim_per_wall": round(sim_s / wall, 4),
+        "wall_s": round(wall, 2),
+        "compile_s": round(compile_wall, 2),
+        "ev_overflow": m["ev_overflow"],
+        "ob_overflow": m["ob_overflow"],
+        "round_cap_hits": m["round_cap_hits"],
+        "rounds_per_window": round(m["rounds"] / max(m["windows"], 1), 2),
+    }
+    for k in ("total_flows_done", "total_streams_done", "clients_done",
+              "total_cells_fwd", "total_rx_bytes", "txs_seen_total"):
+        if k in summary:
+            row[k] = int(summary[k])
+    return row
+
+
+def run_oracle_slice(name: str, path: str, tpu_row: dict) -> dict:
+    """Bounded oracle run: whole windows until the event budget is hit."""
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.cpu_engine import CpuEngine
+
+    exp, params, _ = load_experiment(path)
+    cpu = CpuEngine(exp, params)
+    t0 = time.perf_counter()
+    done = 0
+    cm = {"events": 0}
+    while done < tpu_row["windows"]:
+        step = max(1, tpu_row["windows"] // 50)
+        cm = cpu.run(n_windows=done + step)
+        done += step
+        if cm["events"] >= ORACLE_EVENT_BUDGET or time.perf_counter() - t0 > 120:
+            break
+    wall = time.perf_counter() - t0
+    return {
+        "oracle_windows": done,
+        "oracle_events": cm["events"],
+        "oracle_wall_s": round(wall, 2),
+        "oracle_events_per_sec": round(cm["events"] / wall, 1) if wall else None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("rungs", nargs="*", default=None)
+    ap.add_argument("--windows", type=int, default=None)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--no-oracle", action="store_true")
+    args = ap.parse_args()
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+
+    names = args.rungs or list(RUNGS)
+    rows = []
+    for name in names:
+        path = RUNGS[name]
+        try:
+            row = run_rung(name, path, args.windows)
+            if not args.no_oracle:
+                row.update(run_oracle_slice(name, path, row))
+                if row.get("oracle_events_per_sec"):
+                    row["vs_oracle"] = round(
+                        row["events_per_sec"] / row["oracle_events_per_sec"], 2
+                    )
+        except Exception as e:  # noqa: BLE001 — record the failure, keep going
+            import traceback
+
+            row = {"rung": name, "config": path, "error": repr(e)[:400],
+                   "traceback": traceback.format_exc()[-1500:]}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        ok = "error" not in row
+        print(
+            f"[{name}] " + (
+                f"{row['events_per_sec']:>12,.0f} ev/s  sim/wall "
+                f"{row['sim_per_wall']:.3f}  wall {row['wall_s']}s  "
+                f"overflow {row['ev_overflow']}+{row['ob_overflow']}"
+                if ok else f"FAILED: {row['error']}"
+            ),
+            file=sys.stderr, flush=True,
+        )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

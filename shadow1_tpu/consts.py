@@ -112,6 +112,10 @@ class EngineParams:
     send_burst: int = 4
     # Max inner rounds per window (safety bound; overflow is counted).
     max_rounds: int = 256
+    # Sharded engine: per-(src shard → dst shard) all_to_all bucket capacity
+    # per window. 0 = auto (2× the uniform-traffic expectation, min 16).
+    # Bucket-full drops are counted (x2x_overflow); parity requires 0.
+    x2x_cap: int = 0
 
     # --- TCP constants (reference: src/main/host/descriptor/tcp.c) ---
     mss: int = 1460               # bytes per segment

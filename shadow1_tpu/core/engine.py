@@ -54,6 +54,8 @@ class Metrics(NamedTuple):
     tcp_fast_rtx: jnp.ndarray    # fast-retransmit (3 dup-ACK) episodes
     tcp_rto: jnp.ndarray         # retransmit-timeout episodes
     tcp_ooo_drops: jnp.ndarray   # out-of-order segments dropped (GBN receiver)
+    x2x_overflow: jnp.ndarray    # packets dropped: all_to_all bucket full
+                                 # (sharded engine only; parity needs 0)
 
 
 def _metrics_init() -> Metrics:
@@ -233,14 +235,15 @@ def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
 
 
 def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
-    """Window-end packet exchange: route, (all_gather under sharding), scatter.
+    """Window-end packet exchange: route, (all_to_all under sharding), scatter.
 
-    ``exchange`` maps FlatPackets → FlatPackets across the mesh (identity on
-    a single device; a tiled all_gather over the host axis when sharded —
-    the one collective per window, SURVEY §2.5)."""
+    ``exchange`` maps FlatPackets → (FlatPackets, n_dropped) across the mesh
+    (identity on a single device; a bucketed all_to_all over the host axis
+    when sharded — the one collective per window, SURVEY §2.5)."""
     fp, n_sent, n_lost = route_outbox(ctx, st.outbox)
+    n_x2x = jnp.zeros((), jnp.int64)
     if exchange is not None:
-        fp = exchange(fp)
+        fp, n_x2x = exchange(fp)
     evbuf, n_deliv, n_over = deliver_flat(st.evbuf, ctx, fp)
     m = st.metrics
     return st._replace(
@@ -251,6 +254,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             pkts_delivered=m.pkts_delivered + n_deliv,
             pkts_lost=m.pkts_lost + n_lost,
             ev_overflow=m.ev_overflow + n_over,
+            x2x_overflow=m.x2x_overflow + n_x2x,
         ),
     )
 

@@ -47,8 +47,11 @@ def _dump_table() -> None:
 
 def ensure_built(force: bool = False) -> pathlib.Path:
     src = _DIR / "phold_comparator.cpp"
+    rng_src = _REPO / "shadow1_tpu" / "rng.py"
     _BUILD.mkdir(parents=True, exist_ok=True)
-    if force or not _TABLE.exists():
+    # Re-dump when rng.py is newer than the table: a stale table would make
+    # the comparator silently non-identical to the jnp/numpy engines.
+    if force or not _TABLE.exists() or _TABLE.stat().st_mtime < rng_src.stat().st_mtime:
         _dump_table()
     if not force and _BIN.exists() and _BIN.stat().st_mtime >= src.stat().st_mtime:
         return _BIN

@@ -7,15 +7,25 @@ the TPU-native equivalent shards the host axis of every state tensor over a
 runs its local block's rounds completely independently (the conservative
 lookahead guarantees no mid-window cross-host interaction — the same
 invariant the reference's barrier rounds rely on); at the window end the
-routed packet batch is exchanged with ONE tiled ``all_gather`` over the mesh
-axis and each shard scatters the packets addressed to its hosts. That single
+shard buckets its routed packets by destination shard and ONE
+``lax.all_to_all`` over the mesh axis delivers every bucket to its owner;
+each shard then scatters the packets addressed to its hosts. That single
 collective per window is the entire communication schedule — it rides ICI
 within a slice and DCN across slices, replacing the reference's locked
 cross-thread event push (src/main/utility/async-priority-queue.c).
+Exchanged bytes scale with the per-destination bucket capacity
+(``EngineParams.x2x_cap``, auto-sized to 2× the uniform-traffic
+expectation), NOT with ×n_dev as the earlier all_gather did. Bucket-full
+drops are counted in ``x2x_overflow``; ``run()`` raises by default when
+any occurred (``check_x2x=False`` to opt out), because a silent drop in
+the collective would quietly break the determinism contract the
+all_gather held by construction.
 
-Determinism across shardings: the gathered packet order is shard-major ×
-host-major = global host-major — exactly the single-device flatten order —
-and all event/tie-break keys are computed from global host ids, so the
+Determinism across shardings: within a shard's outbound, the bucket sort is
+stable in flat source order and received buckets concatenate in
+source-shard order, so each destination sees its packets in shard-major ×
+host-major = global host-major order — exactly the single-device flatten
+order — and all event/tie-break keys are computed from global host ids, so the
 delivered event streams are identical for any device count. The
 ``rounds``/``round_cap_hits`` metrics are the one exception (each shard
 counts its own inner rounds; they are summed), so they are performance
@@ -35,12 +45,13 @@ from shadow1_tpu.consts import EngineParams
 from shadow1_tpu.core.engine import (
     Ctx,
     Engine,
+    FlatPackets,
     SimState,
     _metrics_init,
     _model_module,
     window_step,
 )
-from shadow1_tpu.core.events import evbuf_init
+from shadow1_tpu.core.events import _hi, _join, _lo, evbuf_init
 from shadow1_tpu.core.outbox import outbox_init
 
 
@@ -133,6 +144,11 @@ class ShardedEngine:
         bw_up_g = self.global_ctx.bw_up
         bw_dn_g = self.global_ctx.bw_dn
 
+        # Per-(src→dst shard) bucket capacity: explicit knob or 2× the
+        # uniform-traffic expectation (N_local / n_dev), min 16.
+        n_local = h_local * pr.outbox_cap
+        x2x_cap = pr.x2x_cap or max(16, -(-2 * n_local // n_dev))
+
         def block(st: SimState, hosts, bw_up, bw_dn, n_windows: int) -> SimState:
             ctx = Ctx(
                 n_hosts=h_local,
@@ -151,12 +167,58 @@ class ShardedEngine:
             )
             handlers = model.make_handlers(ctx)
 
-            def exchange(fp):
-                # The one collective per window (SURVEY §2.5): tiled gather
-                # of every shard's routed packets, shard-major order.
-                return jax.tree.map(
-                    lambda x: jax.lax.all_gather(x, axis, tiled=True), fp
+            def exchange(fp: FlatPackets):
+                # The one collective per window (SURVEY §2.5): bucket local
+                # packets by destination shard (stable in flat source order),
+                # all_to_all the fixed-capacity buckets, concatenate received
+                # buckets in source-shard order. All fields ride one stacked
+                # i32 tensor (i64 halves split like core/events.deliver_batch).
+                n = fp.dst.shape[0]
+                nb = max((n - 1).bit_length(), 1)
+                wide = (n_dev + 1) << nb > 2**31 - 1
+                kdt = jnp.int64 if wide else jnp.int32
+                dshard = jnp.where(fp.keep, fp.dst // h_local, n_dev)
+                skey = (dshard.astype(kdt) << nb) | jnp.arange(n, dtype=kdt)
+                (skey_s,) = jax.lax.sort((skey,), is_stable=False)
+                dshard_s = (skey_s >> nb).astype(jnp.int32)
+                idx_s = (skey_s & ((1 << nb) - 1)).astype(jnp.int32)
+                seg = jnp.searchsorted(
+                    dshard_s, jnp.arange(n_dev + 1, dtype=jnp.int32), side="left"
                 )
+                pos = seg[:-1, None] + jnp.arange(x2x_cap, dtype=jnp.int32)[None, :]
+                valid = pos < seg[1:, None]                   # [n_dev, K]
+                src = idx_s[jnp.minimum(pos, n - 1)]          # [n_dev, K]
+                dropped = (
+                    fp.keep.sum(dtype=jnp.int64) - valid.sum(dtype=jnp.int64)
+                )
+                stacked = jnp.concatenate(
+                    [
+                        fp.dst[:, None],
+                        _lo(fp.arrival), _hi(fp.arrival),
+                        _lo(fp.tb), _hi(fp.tb),
+                        fp.kind[:, None],
+                        fp.p,
+                    ],
+                    axis=1,
+                )                                             # [N, 6+NP] i32
+                send = jnp.where(valid[:, :, None], stacked[src], 0)
+                send = jnp.concatenate(
+                    [send, valid[:, :, None].astype(jnp.int32)], axis=2
+                )                                             # [n_dev, K, 7+NP]
+                recv = jax.lax.all_to_all(
+                    send, axis, split_axis=0, concat_axis=0
+                )                                             # row s = from shard s
+                r = recv.reshape(n_dev * x2x_cap, recv.shape[2])
+                keep = r[:, -1] != 0
+                out = FlatPackets(
+                    dst=jnp.where(keep, r[:, 0], 0),
+                    arrival=_join(r[:, 1], r[:, 2]),
+                    tb=_join(r[:, 3], r[:, 4]),
+                    kind=r[:, 5],
+                    p=r[:, 6:-1],
+                    keep=keep,
+                )
+                return out, dropped
 
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
@@ -187,10 +249,25 @@ class ShardedEngine:
         return run
 
     # -- public ------------------------------------------------------------
-    def run(self, st: SimState | None = None, n_windows: int | None = None) -> SimState:
+    def run(self, st: SimState | None = None, n_windows: int | None = None,
+            check_x2x: bool = True) -> SimState:
         if st is None:
             st = self.init_state()
-        return self._run_jit(st, n_windows if n_windows is not None else self.n_windows)
+        st = self._run_jit(st, n_windows if n_windows is not None else self.n_windows)
+        if check_x2x:
+            # Loud failure beats silently-wrong results: a full all_to_all
+            # bucket means packets vanished and single-device parity is
+            # gone. Re-run with a larger EngineParams.x2x_cap (or pass
+            # check_x2x=False to inspect the partial state).
+            drops = int(st.metrics.x2x_overflow)
+            if drops:
+                raise RuntimeError(
+                    f"{drops} packets dropped by full all_to_all buckets "
+                    f"(x2x_cap too small for this traffic pattern) — results "
+                    f"diverge from the single-device engine; raise "
+                    f"EngineParams.x2x_cap or pass check_x2x=False"
+                )
+        return st
 
     metrics_dict = staticmethod(Engine.metrics_dict)
 

@@ -18,6 +18,7 @@ from shadow1_tpu.shard.engine import ShardedEngine
 SEMANTIC_KEYS = [
     "events", "windows", "pkts_sent", "pkts_delivered", "pkts_lost",
     "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+    "x2x_overflow",  # all_to_all bucket drops: must be 0 (== single-device)
 ]
 
 
@@ -55,6 +56,32 @@ def test_phold_sharded_parity():
     m1, s1, m8, s8 = run_pair(exp)
     assert m1["events"] > 500  # the workload actually ran
     assert_same(m1, s1, m8, s8, summary_keys=("hops",))
+
+
+def test_x2x_bucket_overflow_is_counted():
+    """A deliberately tiny all_to_all bucket must DROP (not corrupt), count
+    every dropped packet in x2x_overflow, and fail loudly by default."""
+    import pytest
+
+    exp = single_vertex_experiment(
+        n_hosts=64, seed=7, end_time=50 * MS, latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 4},
+    )
+    full = ShardedEngine(exp, EngineParams()).run()
+    fm = ShardedEngine.metrics_dict(full)
+    assert fm["x2x_overflow"] == 0
+    with pytest.raises(RuntimeError, match="x2x_cap"):
+        ShardedEngine(exp, EngineParams(x2x_cap=1)).run()
+    tiny = ShardedEngine(exp, EngineParams(x2x_cap=1)).run(check_x2x=False)
+    tm = ShardedEngine.metrics_dict(tiny)
+    assert tm["x2x_overflow"] > 0
+    # sent minus (lost + delivered + dropped buckets + full-evbuf drops) = 0
+    assert (
+        tm["pkts_sent"]
+        == tm["pkts_lost"] + tm["pkts_delivered"] + tm["x2x_overflow"]
+        + tm["ev_overflow"]
+    ), tm
 
 
 def test_tor_sharded_parity():
