@@ -22,21 +22,23 @@ import json
 import sys
 import time
 
+# rung -> (config, initial chunk). Heavy net rungs start with small chunks:
+# the tunneled device faults on long single executions, and tor/bitcoin
+# windows are orders of magnitude heavier than phold/tgen ones.
 RUNGS = {
-    "rung1": "configs/rung1_filexfer.yaml",
-    "rung2": "configs/rung2_tgen100.yaml",
-    "rung3": "configs/rung3_tor1k.yaml",
-    "rung4": "configs/rung4_tor10k.yaml",
-    "rung5": "configs/rung5_bitcoin5k.yaml",
+    "rung1": ("configs/rung1_filexfer.yaml", 100),
+    "rung2": ("configs/rung2_tgen100.yaml", 100),
+    "rung3": ("configs/rung3_tor1k.yaml", 20),
+    "rung4": ("configs/rung4_tor10k.yaml", 10),
+    "rung5": ("configs/rung5_bitcoin5k.yaml", 20),
 }
-CHUNK = 100
 ORACLE_EVENT_BUDGET = 200_000  # stop the oracle slice near this many events
 
 
-def run_rung(name: str, path: str, windows_override: int | None) -> dict:
+def run_rung(name: str, path: str, windows_override: int | None,
+             chunk0: int = 100) -> dict:
     import jax
 
-    from shadow1_tpu import ckpt
     from shadow1_tpu.config.experiment import load_experiment
     from shadow1_tpu.consts import SEC
     from shadow1_tpu.core.engine import Engine
@@ -45,17 +47,33 @@ def run_rung(name: str, path: str, windows_override: int | None) -> dict:
     eng = Engine(exp, params)
     total = windows_override or eng.n_windows
 
+    # n_windows is traced, so a zero-window call compiles the exact program
+    # every chunk reuses — compile never rides a long device execution.
     t0 = time.perf_counter()
-    warm_w = min(CHUNK, total)
-    jax.block_until_ready(eng.run(eng.init_state(), n_windows=warm_w))
-    tail = total % CHUNK if total > CHUNK else 0
-    if tail:
-        jax.block_until_ready(eng.run(eng.init_state(), n_windows=tail))
+    jax.block_until_ready(eng.run(eng.init_state(), n_windows=0))
     compile_wall = time.perf_counter() - t0
 
+    # Adaptive chunking: the tunneled device faults on long single
+    # executions (round-2 postmortem; reproduced on rung3's bootstrap-heavy
+    # tor windows). On a runtime fault, shrink the chunk and retry — the
+    # input state is host-managed and intact.
     t0 = time.perf_counter()
-    st = ckpt.run_chunked(eng, n_windows=total, chunk=CHUNK)
-    jax.block_until_ready(st)
+    st = eng.init_state()
+    done, chunk, faults = 0, chunk0, 0
+    while done < total:
+        step = min(chunk, total - done)
+        try:
+            nxt = eng.run(st, n_windows=step)
+            jax.block_until_ready(nxt)
+            st, done = nxt, done + step
+        except Exception as e:  # noqa: BLE001 — jax runtime faults
+            faults += 1
+            if chunk <= 5 or faults > 6:
+                raise RuntimeError(
+                    f"device faulted at {done}/{total} windows "
+                    f"(chunk {step}): {e!r}"
+                ) from e
+            chunk = max(5, chunk // 4)
     wall = time.perf_counter() - t0
     m = Engine.metrics_dict(st)
     summary = eng.model_summary(st)
@@ -78,9 +96,11 @@ def run_rung(name: str, path: str, windows_override: int | None) -> dict:
         "ob_overflow": m["ob_overflow"],
         "round_cap_hits": m["round_cap_hits"],
         "rounds_per_window": round(m["rounds"] / max(m["windows"], 1), 2),
+        "chunk_final": chunk,
+        "device_faults_recovered": faults,
     }
     for k in ("total_flows_done", "total_streams_done", "clients_done",
-              "total_cells_fwd", "total_rx_bytes", "txs_seen_total"):
+              "total_cells_fwd", "total_rx_bytes", "total_seen"):
         if k in summary:
             row[k] = int(summary[k])
     return row
@@ -127,9 +147,9 @@ def main() -> None:
     names = args.rungs or list(RUNGS)
     rows = []
     for name in names:
-        path = RUNGS[name]
+        path, chunk0 = RUNGS[name]
         try:
-            row = run_rung(name, path, args.windows)
+            row = run_rung(name, path, args.windows, chunk0)
             if not args.no_oracle:
                 row.update(run_oracle_slice(name, path, row))
                 if row.get("oracle_events_per_sec"):

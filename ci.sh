@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI driver — the `./setup test` analogue (reference: setup + cmake + ctest).
+#
+#   ./ci.sh            fast tier: full suite minus the slow mid-scale tier
+#   ./ci.sh all        everything, including 512–1024-host parity
+#   ./ci.sh smoke      import + config + events only (~seconds)
+#
+# Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
+# so CI needs no accelerator; the TPU-hardware path is covered separately
+# by tests/test_backend_parity.py, which skips cleanly when absent.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+tier="${1:-fast}"
+case "$tier" in
+  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py -q ;;
+  fast)  exec python -m pytest tests/ -q -m "not slow" ;;
+  all)   exec python -m pytest tests/ -q ;;
+  *) echo "usage: $0 [smoke|fast|all]" >&2; exit 2 ;;
+esac

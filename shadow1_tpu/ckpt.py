@@ -6,7 +6,7 @@ just the flattened tree serialized to one .npz file; resume loads it back
 into the treedef of a freshly-initialized state and continues the window
 loop. Determinism makes this exact: a run that checkpoints and resumes
 produces bit-identical results to an uninterrupted run (tested in
-tests/test_ckpt.py).
+tests/test_ckpt_obs.py).
 """
 
 from __future__ import annotations

@@ -33,6 +33,11 @@ def main(argv=None) -> int:
                     help="snapshot final engine state to PATH (.npz)")
     ap.add_argument("--resume", default=None, metavar="PATH",
                     help="resume from a state snapshot (batched engines)")
+    ap.add_argument("--tracker", default=None, metavar="PATH",
+                    help="write final per-host tracker records (JSON lines)")
+    ap.add_argument("--log-level", default="message",
+                    choices=["error", "warning", "message", "info", "debug"],
+                    help="stderr log verbosity (reference --log-level analogue)")
     args = ap.parse_args(argv)
 
     import shadow1_tpu  # noqa: F401  (x64 before jax arrays)
@@ -49,9 +54,15 @@ def main(argv=None) -> int:
         force_cpu(1)
     else:
         ensure_live_platform(min_devices=1)
-    if engine_kind == "cpu" and (args.save_state or args.resume or args.heartbeat):
-        ap.error("--save-state/--resume/--heartbeat require a batched engine "
-                 "(tpu or sharded)")
+    if engine_kind == "cpu" and (args.save_state or args.resume
+                                 or args.heartbeat or args.tracker):
+        ap.error("--save-state/--resume/--heartbeat/--tracker require a "
+                 "batched engine (tpu or sharded)")
+    from shadow1_tpu.log import SimLogger
+
+    log = SimLogger(level=args.log_level)
+    log.info("experiment loaded", hosts=exp.n_hosts, engine=engine_kind,
+             window_ns=exp.window)
     t0 = time.perf_counter()
     metrics0: dict[str, int] = {}
 
@@ -97,6 +108,12 @@ def main(argv=None) -> int:
         metrics = Eng.metrics_dict(st)
         summary = eng.model_summary(st)
         n_windows = args.windows if args.windows is not None else eng.n_windows
+        if args.tracker:
+            from shadow1_tpu.log import tracker_records
+
+            with open(args.tracker, "w") as f:
+                for rec in tracker_records(eng, st):
+                    f.write(json.dumps(rec) + "\n")
 
     wall = time.perf_counter() - t0
     sim_s = n_windows * exp.window / 1e9

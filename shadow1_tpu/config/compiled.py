@@ -24,6 +24,9 @@ from typing import Any
 import numpy as np
 
 
+NO_STOP = (1 << 62)  # "host never stops" sentinel (i64-safe)
+
+
 @dataclasses.dataclass
 class CompiledExperiment:
     n_hosts: int
@@ -36,17 +39,51 @@ class CompiledExperiment:
     bw_dn: np.ndarray             # i64 [H] downlink bits/s
     model: str = "phold"          # workload model name
     model_cfg: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # --- fidelity knobs (reference: router.c queues, config churn, edge
+    # jitter, host/cpu.c), all defaulted off ---
+    jitter_vv: np.ndarray | None = None   # i64 [V,V] max ± jitter ns per pkt
+    stop_time: np.ndarray | None = None   # i64 [H] host halts at this time
+    cpu_ns_per_event: np.ndarray | None = None  # i64 [H] virtual CPU cost
+    tx_qlen_bytes: np.ndarray | None = None     # i64 [H] NIC up-queue, 0=inf
+    rx_qlen_bytes: np.ndarray | None = None     # i64 [H] NIC down-queue, 0=inf
+    # Host-side name registry (config/dns.py); None for programmatic
+    # experiments (ids only). Never enters device state.
+    dns: Any = None
+
+    def __post_init__(self):
+        h, z = self.n_hosts, np.int64
+        if self.jitter_vv is None:
+            self.jitter_vv = np.zeros_like(self.lat_vv, z)
+        if self.stop_time is None:
+            self.stop_time = np.full(h, NO_STOP, z)
+        if self.cpu_ns_per_event is None:
+            self.cpu_ns_per_event = np.zeros(h, z)
+        if self.tx_qlen_bytes is None:
+            self.tx_qlen_bytes = np.zeros(h, z)
+        if self.rx_qlen_bytes is None:
+            self.rx_qlen_bytes = np.zeros(h, z)
 
     @property
     def window(self) -> int:
-        """Conservative lookahead window = min path latency (runahead)."""
-        return int(self.lat_vv.min())
+        """Conservative lookahead = min worst-case path latency (runahead).
+
+        With jitter the bound is min(lat − jitter): the earliest any packet
+        can arrive (the reference computes runahead from minimum link
+        latency in src/main/core/master.c)."""
+        return int((self.lat_vv - self.jitter_vv).min())
 
     def validate(self) -> None:
         assert self.lat_vv.min() > 0, "zero-latency paths break the conservative window"
-        assert self.lat_vv.shape == self.loss_vv.shape
+        assert self.lat_vv.shape == self.loss_vv.shape == self.jitter_vv.shape
+        assert (self.jitter_vv >= 0).all()
+        assert (self.lat_vv - self.jitter_vv).min() > 0, (
+            "jitter ≥ latency would allow arrivals inside the current window"
+        )
         assert self.host_vertex.max() < self.lat_vv.shape[0]
         assert (self.bw_up > 0).all() and (self.bw_dn > 0).all()
+        assert (self.stop_time > 0).all()
+        assert (self.cpu_ns_per_event >= 0).all()
+        assert (self.tx_qlen_bytes >= 0).all() and (self.rx_qlen_bytes >= 0).all()
         assert self.end_time > 0
 
 
@@ -59,10 +96,13 @@ def single_vertex_experiment(
     bw_bits: int = 10**9,
     model: str = "phold",
     model_cfg: dict | None = None,
+    jitter_ns: int = 0,
+    **fidelity,
 ) -> CompiledExperiment:
     """Minimal topology: every host on one vertex, uniform latency/loss.
 
     Mirrors the reference's minimal example configs (resource/examples/).
+    ``fidelity`` passes through stop_time / cpu_ns_per_event / *_qlen_bytes.
     """
     return CompiledExperiment(
         n_hosts=n_hosts,
@@ -70,9 +110,11 @@ def single_vertex_experiment(
         end_time=end_time,
         lat_vv=np.full((1, 1), latency_ns, np.int64),
         loss_vv=np.full((1, 1), loss, np.float32),
+        jitter_vv=np.full((1, 1), jitter_ns, np.int64),
         host_vertex=np.zeros(n_hosts, np.int32),
         bw_up=np.full(n_hosts, bw_bits, np.int64),
         bw_dn=np.full(n_hosts, bw_bits, np.int64),
         model=model,
         model_cfg=model_cfg or {},
+        **fidelity,
     )

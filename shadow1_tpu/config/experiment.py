@@ -44,6 +44,7 @@ from typing import Any
 import numpy as np
 
 from shadow1_tpu.config.compiled import CompiledExperiment
+from shadow1_tpu.config.dns import Dns
 from shadow1_tpu.config.topology import compile_paths, load_graphml
 from shadow1_tpu.consts import MS, NS, SEC, US, EngineParams
 
@@ -85,6 +86,10 @@ class HostGroup:
     vertex_spec: Any
     bw_up: int
     bw_dn: int
+    stop_time: int      # ns the host halts (churn); NO_STOP = never
+    cpu_ns_per_event: int
+    tx_qlen_bytes: int  # NIC uplink queue bound (0 = unbounded)
+    rx_qlen_bytes: int
 
     @property
     def ids(self) -> np.ndarray:
@@ -133,6 +138,8 @@ _APP_PARAMS: dict[str, dict[str, tuple]] = {
 
 
 def _expand_hosts(spec: list[dict]) -> list[HostGroup]:
+    from shadow1_tpu.config.compiled import NO_STOP
+
     groups, start = [], 0
     for g in spec:
         count = int(g.get("count", 1))
@@ -143,6 +150,14 @@ def _expand_hosts(spec: list[dict]) -> list[HostGroup]:
             vertex_spec=g.get("vertex", 0),
             bw_up=parse_bw_bits(g.get("bandwidth_up", "1 Gbit")),
             bw_dn=parse_bw_bits(g.get("bandwidth_down", "1 Gbit")),
+            stop_time=(
+                parse_time_ns(g["stop_time"]) if "stop_time" in g else NO_STOP
+            ),
+            cpu_ns_per_event=(
+                parse_time_ns(g["cpu_per_event"]) if "cpu_per_event" in g else 0
+            ),
+            tx_qlen_bytes=int(g.get("tx_queue_bytes", 0)),
+            rx_qlen_bytes=int(g.get("rx_queue_bytes", 0)),
         ))
         start += count
     return groups
@@ -239,6 +254,13 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         names = ["v0"]
         lat_vv = np.full((1, 1), parse_time_ns(sv.get("latency", "10 ms")), np.int64)
         loss_vv = np.full((1, 1), float(sv.get("loss", 0.0)), np.float32)
+    # Per-packet path-latency jitter amplitude (± ns), uniform over all
+    # paths. (Per-edge graphml jitter attributes are NOT read yet — a
+    # config must set network.jitter explicitly.)
+    jitter = net.get("jitter")
+    jitter_vv = (
+        np.full_like(lat_vv, parse_time_ns(jitter)) if jitter is not None else None
+    )
 
     # -- hosts -------------------------------------------------------------
     groups = _expand_hosts(doc.get("hosts", [{"name": "host", "count": 1}]))
@@ -246,9 +268,17 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
     host_vertex = _vertex_assignment(groups, names, h)
     bw_up = np.zeros(h, np.int64)
     bw_dn = np.zeros(h, np.int64)
+    stop_time = np.zeros(h, np.int64)
+    cpu_ns = np.zeros(h, np.int64)
+    tx_qlen = np.zeros(h, np.int64)
+    rx_qlen = np.zeros(h, np.int64)
     for g in groups:
         bw_up[g.ids] = g.bw_up
         bw_dn[g.ids] = g.bw_dn
+        stop_time[g.ids] = g.stop_time
+        cpu_ns[g.ids] = g.cpu_ns_per_event
+        tx_qlen[g.ids] = g.tx_qlen_bytes
+        rx_qlen[g.ids] = g.rx_qlen_bytes
 
     # -- app ---------------------------------------------------------------
     appsec = doc.get("app", {"model": "phold"})
@@ -310,6 +340,12 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         bw_dn=bw_dn,
         model=model,
         model_cfg=model_cfg,
+        jitter_vv=jitter_vv,
+        stop_time=stop_time,
+        cpu_ns_per_event=cpu_ns,
+        tx_qlen_bytes=tx_qlen,
+        rx_qlen_bytes=rx_qlen,
+        dns=Dns.from_groups(groups, host_vertex),
     )
     exp.validate()
     return exp, params, scheduler

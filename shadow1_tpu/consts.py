@@ -86,6 +86,7 @@ R_LOSS = 3
 R_APP = 4
 R_TOR_PATH = 5
 R_BTC = 6
+R_JITTER = 7  # per-packet edge-latency jitter (ctr = src pkt counter)
 
 
 @dataclasses.dataclass(frozen=True)

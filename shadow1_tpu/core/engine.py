@@ -29,7 +29,7 @@ import numpy as np
 
 from shadow1_tpu import rng
 from shadow1_tpu.config.compiled import CompiledExperiment
-from shadow1_tpu.consts import R_LOSS, EngineParams, packet_tb
+from shadow1_tpu.consts import R_JITTER, R_LOSS, EngineParams, packet_tb
 from shadow1_tpu.core.events import (
     EventBuf,
     Popped,
@@ -37,6 +37,7 @@ from shadow1_tpu.core.events import (
     deliver_batch,
     evbuf_init,
     pop_until,
+    push_back,
 )
 from shadow1_tpu.core.outbox import Outbox, outbox_clear, outbox_init
 
@@ -56,6 +57,10 @@ class Metrics(NamedTuple):
     tcp_ooo_drops: jnp.ndarray   # out-of-order segments dropped (GBN receiver)
     x2x_overflow: jnp.ndarray    # packets dropped: all_to_all bucket full
                                  # (sharded engine only; parity needs 0)
+    down_events: jnp.ndarray     # events discarded: host stopped (churn)
+    down_pkts: jnp.ndarray       # packets dropped: destination host stopped
+    nic_tx_drops: jnp.ndarray    # packets dropped: NIC uplink queue full
+    nic_rx_drops: jnp.ndarray    # packets dropped: NIC downlink queue full
 
 
 def _metrics_init() -> Metrics:
@@ -69,6 +74,7 @@ class SimState(NamedTuple):
     outbox: Outbox
     model: Any              # workload-model pytree
     metrics: Metrics
+    cpu_busy: jnp.ndarray   # i64 [H] virtual CPU free-at (host/cpu.c model)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +103,18 @@ class Ctx:
     model_cfg: dict
     hosts: jax.Array = None  # i32 [H] global host ids of this block
     loss_thr_vv: jax.Array = None  # u64 [V, V] Bernoulli thresholds
+    # Fidelity knobs (all local [H] / [V,V]; see CompiledExperiment). The
+    # has_* flags are TRACE-TIME booleans so disabled features compile to
+    # nothing.
+    jitter_vv: jax.Array = None    # i64 [V, V]
+    stop_time: jax.Array = None    # i64 [H]
+    cpu_cost: jax.Array = None     # i64 [H] virtual CPU ns per event
+    tx_qlen_ns: jax.Array = None   # i64 [H] uplink queue bound (ns of backlog)
+    rx_qlen_ns: jax.Array = None   # i64 [H]
+    has_jitter: bool = False
+    has_stop: bool = False
+    has_cpu: bool = False
+    has_qlen: bool = False
 
     def __post_init__(self):
         if self.hosts is None:
@@ -163,18 +181,52 @@ class FlatPackets(NamedTuple):
 def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     """One inner round: per-host pop-min + the handler passes.
 
+    Two fidelity gates apply between pop and dispatch (both compile to
+    nothing when the knobs are off):
+
+    * **churn** (config host stop times): an event whose time is ≥ its
+      host's stop_time is discarded (counted in ``down_events``) — the
+      batch analogue of the reference halting a host's processes;
+    * **virtual CPU** (src/main/host/cpu.c): execution time is
+      ``eff = max(time, cpu_busy[h])``; if eff crosses the window boundary
+      the event re-queues at (eff, original tb) unexecuted, else it
+      executes with ``now = eff`` and charges ``cpu_busy = eff + cost``.
+      Both engines apply the identical rule in identical per-host order,
+      so the busy clocks evolve identically (docs/SEMANTICS.md).
+
     Each kind's pass is wrapped in ``lax.cond`` on "any host popped this
     kind this round" — most rounds touch 1–2 of the 5 kinds, so skipping
     the dead passes cuts the round cost correspondingly (handlers draw RNG
     and advance counters only where masked, so an all-false pass is a
     no-op by construction and skipping it is exact)."""
     evbuf, ev = pop_until(st.evbuf, win_end)
+    st = st._replace(evbuf=evbuf)
     m = st.metrics
+    n_down = jnp.zeros((), jnp.int64)
+    if ctx.has_stop:
+        supp = ev.mask & (ev.time >= ctx.stop_time)
+        n_down = supp.sum(dtype=jnp.int64)
+        ev = ev._replace(mask=ev.mask & ~supp,
+                         kind=jnp.where(supp, 0, ev.kind))
+    if ctx.has_cpu:
+        eff = jnp.maximum(ev.time, st.cpu_busy)
+        defer = ev.mask & (eff >= win_end)
+        run = ev.mask & ~defer
+        evbuf, over = push_back(
+            st.evbuf, defer, eff, ev.tb, ev.kind, ev.p
+        )
+        st = st._replace(
+            evbuf=evbuf,
+            cpu_busy=jnp.where(run, eff + ctx.cpu_cost, st.cpu_busy),
+        )
+        m = m._replace(ev_overflow=m.ev_overflow + over.sum(dtype=jnp.int64))
+        ev = ev._replace(mask=run, time=jnp.where(run, eff, ev.time),
+                         kind=jnp.where(defer, 0, ev.kind))
     st = st._replace(
-        evbuf=evbuf,
         metrics=m._replace(
             events=m.events + ev.mask.sum(dtype=jnp.int64),
             rounds=m.rounds + 1,
+            down_events=m.down_events + n_down,
         ),
     )
     items = sorted(handlers.items())
@@ -205,6 +257,12 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.nd
     vs = ctx.host_vertex[fsrc]
     vd = ctx.host_vertex[fdst_safe]
     arrival = flat(ob.depart) + ctx.lat_vv[vs, vd]
+    if ctx.has_jitter:
+        # Per-packet edge jitter in [-J, +J] (reference: topology edge
+        # jitter attribute); J < lat so the conservative window holds.
+        jit = ctx.jitter_vv[vs, vd]
+        jbits = rng.bits_v(ctx.key, R_JITTER, fsrc, flat(ob.ctr))
+        arrival = arrival + rng.randint(jbits, 2 * jit + 1).astype(jnp.int64) - jit
     bits = rng.bits_v(ctx.key, R_LOSS, fsrc, flat(ob.ctr))
     # Integer Bernoulli on precomputed thresholds (rng.prob_threshold) —
     # shared with the CPU oracle, backend-exact by construction.
@@ -222,16 +280,24 @@ def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
     """Scatter (possibly gathered) packets into this block's event buffers.
 
     Maps global dst ids onto the local block (contiguous range starting at
-    ctx.hosts[0]); packets for other blocks are masked out. Returns
-    (evbuf, n_delivered, n_overflow) counting only this block's packets."""
+    ctx.hosts[0]); packets for other blocks are masked out; packets whose
+    arrival is past the destination's stop_time are dropped here (churn —
+    counted, never delivered, so a stopped host's buffers stay clean).
+    Returns (evbuf, n_delivered, n_overflow, n_down) counting only this
+    block's packets."""
     base = ctx.hosts[0].astype(fp.dst.dtype)
     local = fp.dst - base
     mine = fp.keep & (local >= 0) & (local < ctx.n_hosts)
     local = jnp.where(mine, local, 0)
+    n_down = jnp.zeros((), jnp.int64)
+    if ctx.has_stop:
+        to_down = mine & (fp.arrival >= ctx.stop_time[local])
+        n_down = to_down.sum(dtype=jnp.int64)
+        mine = mine & ~to_down
     evbuf, n_over = deliver_batch(
         evbuf, local, fp.arrival, fp.tb, fp.kind, fp.p, mine
     )
-    return evbuf, mine.sum(dtype=jnp.int64) - n_over, n_over
+    return evbuf, mine.sum(dtype=jnp.int64) - n_over, n_over, n_down
 
 
 def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
@@ -244,7 +310,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     n_x2x = jnp.zeros((), jnp.int64)
     if exchange is not None:
         fp, n_x2x = exchange(fp)
-    evbuf, n_deliv, n_over = deliver_flat(st.evbuf, ctx, fp)
+    evbuf, n_deliv, n_over, n_down = deliver_flat(st.evbuf, ctx, fp)
     m = st.metrics
     return st._replace(
         evbuf=evbuf,
@@ -255,6 +321,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             pkts_lost=m.pkts_lost + n_lost,
             ev_overflow=m.ev_overflow + n_over,
             x2x_overflow=m.x2x_overflow + n_x2x,
+            down_pkts=m.down_pkts + n_down,
         ),
     )
 
@@ -287,6 +354,36 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None) -> SimSta
             windows=m.windows + 1,
             round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
         ),
+    )
+
+
+_QLEN_INF = 1 << 62
+
+
+def qlen_ns_np(qlen_bytes: np.ndarray, bw_bits: np.ndarray) -> np.ndarray:
+    """NIC queue bound in serialization-time ns (0 bytes = unbounded)."""
+    from shadow1_tpu.consts import SEC
+
+    q = np.asarray(qlen_bytes, np.int64)
+    bw = np.asarray(bw_bits, np.int64)
+    return np.where(q > 0, (q * 8 * SEC + bw - 1) // bw, _QLEN_INF)
+
+
+def fidelity_ctx_kwargs(exp) -> dict:
+    """The Ctx fidelity fields + static has_* flags from a CompiledExperiment
+    (shared by Engine and ShardedEngine; everything numpy → device const)."""
+    from shadow1_tpu.config.compiled import NO_STOP
+
+    return dict(
+        jitter_vv=jnp.asarray(exp.jitter_vv, jnp.int64),
+        stop_time=jnp.asarray(exp.stop_time, jnp.int64),
+        cpu_cost=jnp.asarray(exp.cpu_ns_per_event, jnp.int64),
+        tx_qlen_ns=jnp.asarray(qlen_ns_np(exp.tx_qlen_bytes, exp.bw_up)),
+        rx_qlen_ns=jnp.asarray(qlen_ns_np(exp.rx_qlen_bytes, exp.bw_dn)),
+        has_jitter=bool(exp.jitter_vv.max() > 0),
+        has_stop=bool(exp.stop_time.min() < NO_STOP),
+        has_cpu=bool(exp.cpu_ns_per_event.max() > 0),
+        has_qlen=bool((exp.tx_qlen_bytes.max() > 0) or (exp.rx_qlen_bytes.max() > 0)),
     )
 
 
@@ -327,13 +424,17 @@ class Engine:
             bw_up=jnp.asarray(exp.bw_up, jnp.int64),
             bw_dn=jnp.asarray(exp.bw_dn, jnp.int64),
             model_cfg=exp.model_cfg,
+            **fidelity_ctx_kwargs(exp),
         )
         self._model = _model_module(exp.model)
         self._handlers = self._model.make_handlers(self.ctx)
         # No donation: the initial state contains aliased zero-buffers (XLA
         # rejects donating one buffer twice) and run() is called once per sim,
-        # so the single input copy is negligible.
-        self._run_jit = jax.jit(self._make_run(), static_argnums=1)
+        # so the single input copy is negligible. n_windows is a TRACED
+        # argument (fori_loop lowers to while_loop): engine round bodies take
+        # minutes to compile, and a dynamic bound means one compiled program
+        # serves every chunk size / heartbeat / resume window count.
+        self._run_jit = jax.jit(self._make_run())
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> SimState:
@@ -346,6 +447,7 @@ class Engine:
             outbox=outbox_init(self.exp.n_hosts, self.params.outbox_cap),
             model=model,
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
+            cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
         )
 
     # -- window step pieces ----------------------------------------------
@@ -353,8 +455,10 @@ class Engine:
         return window_step(st, self.ctx, self._handlers)
 
     def _make_run(self):
-        def run(st: SimState, n_windows: int) -> SimState:
-            return jax.lax.fori_loop(0, n_windows, lambda _, s: self._window_step(s), st)
+        def run(st: SimState, n_windows) -> SimState:
+            return jax.lax.fori_loop(
+                0, n_windows, lambda _, s: self._window_step(s), st
+            )
 
         return run
 
@@ -362,7 +466,8 @@ class Engine:
     def run(self, st: SimState | None = None, n_windows: int | None = None) -> SimState:
         if st is None:
             st = self.init_state()
-        return self._run_jit(st, n_windows if n_windows is not None else self.n_windows)
+        n = n_windows if n_windows is not None else self.n_windows
+        return self._run_jit(st, jnp.asarray(n, jnp.int32))
 
     @staticmethod
     def metrics_dict(st: SimState) -> dict[str, int]:

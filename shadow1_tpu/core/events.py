@@ -47,6 +47,7 @@ class Popped(NamedTuple):
     time: jnp.ndarray   # i64 [H]
     kind: jnp.ndarray   # i32 [H] (K_NONE where ~mask)
     p: jnp.ndarray      # i32 [H, NP]
+    tb: jnp.ndarray     # i64 [H] original tie-break (for cpu-model requeue)
 
 
 def evbuf_init(n_hosts: int, cap: int) -> EventBuf:
@@ -78,6 +79,25 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
     return buf, mask & ~has_free
 
 
+def push_back(buf: EventBuf, mask, time, tb, kind, p) -> tuple[EventBuf, jnp.ndarray]:
+    """Re-insert a popped event with its ORIGINAL tie-break key.
+
+    Used by the virtual-CPU model when a busy host's event execution slips
+    past the window boundary (docs/SEMANTICS.md §cpu): the event re-enters
+    at (eff_time, original tb), so its order among same-time events is
+    preserved. Does not advance self_ctr."""
+    has_free, first = first_true(buf.kind == K_NONE)
+    ok = mask & has_free
+    w = first & ok[:, None]
+    buf = buf._replace(
+        time=jnp.where(w, jnp.asarray(time, jnp.int64)[..., None], buf.time),
+        tb=jnp.where(w, jnp.asarray(tb, jnp.int64)[..., None], buf.tb),
+        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[..., None], buf.kind),
+        p=jnp.where(w[..., None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
+    )
+    return buf, mask & ~has_free
+
+
 def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
     """Per-host pop of the minimum-(time, tb) event with time < until."""
     elig = (buf.kind != K_NONE) & (buf.time < until)
@@ -92,6 +112,7 @@ def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
         time=jnp.where(mask, min_t, 0),
         kind=jnp.where(mask, get_col(buf.kind, slot), K_NONE),
         p=jnp.where(mask[:, None], get_col(buf.p, slot), 0),
+        tb=jnp.where(mask, get_col(buf.tb, slot), 0),
     )
     sel = onehot_col(slot, buf.time.shape[1], mask)
     buf = buf._replace(

@@ -22,6 +22,7 @@ import numpy as np
 from shadow1_tpu.config.compiled import CompiledExperiment
 from shadow1_tpu.consts import (
     K_PHOLD,
+    R_JITTER,
     R_LOSS,
     R_PHOLD_DELAY,
     R_PHOLD_DST,
@@ -32,9 +33,14 @@ from shadow1_tpu.cpu_engine.rngcache import DrawCache
 
 
 class CpuEngine:
-    def __init__(self, exp: CompiledExperiment, params: EngineParams | None = None):
+    def __init__(self, exp: CompiledExperiment, params: EngineParams | None = None,
+                 capture=None):
+        """``capture(time_ns, src, dst, p, dropped)`` is called for every
+        routed packet (pcap hook — tools/pcap.py; reference per-NIC capture,
+        src/main/utility/pcap-writer.c)."""
         exp.validate()
         self.exp = exp
+        self.capture = capture
         self.params = params or EngineParams()
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
@@ -52,6 +58,15 @@ class CpuEngine:
         self.pkt_ctr = np.zeros(h, np.int64)   # per-src packet counters
         self._ob_win = np.full(h, -1, np.int64)  # outbox accounting: window idx
         self._ob_used = np.zeros(h, np.int64)    # ... sends used this window
+        # Fidelity mirrors (docs/SEMANTICS.md; identical rules to run_round /
+        # route_outbox / deliver_flat in core/engine.py).
+        self.stop_time = np.asarray(exp.stop_time, np.int64)
+        self.has_stop = bool(self.stop_time.min() < (1 << 62))
+        self.cpu_cost = np.asarray(exp.cpu_ns_per_event, np.int64)
+        self.has_cpu = bool(self.cpu_cost.max() > 0)
+        self.cpu_busy = np.zeros(h, np.int64)
+        self.jitter_vv = np.asarray(exp.jitter_vv, np.int64)
+        self.has_jitter = bool(self.jitter_vv.max() > 0)
         self.metrics = {
             "events": 0,
             "pkts_sent": 0,
@@ -59,6 +74,10 @@ class CpuEngine:
             "pkts_lost": 0,
             "ev_overflow": 0,
             "ob_overflow": 0,
+            "down_events": 0,
+            "down_pkts": 0,
+            "nic_tx_drops": 0,
+            "nic_rx_drops": 0,
         }
         self.model = self._make_model()
         self.model.start()
@@ -106,13 +125,24 @@ class CpuEngine:
         vd = int(self.exp.host_vertex[dst])
         if int(self.draws.bits(R_LOSS, src, ctr)) < int(self.loss_thr[vs, vd]):
             self.metrics["pkts_lost"] += 1
+            if self.capture is not None:
+                self.capture(depart, src, dst, p, True)
             return True
         arrival = depart + int(self.exp.lat_vv[vs, vd])
+        if self.has_jitter:
+            jit = int(self.jitter_vv[vs, vd])
+            if jit:
+                arrival += self.draws.randint(R_JITTER, src, ctr, 2 * jit + 1) - jit
+        if self.has_stop and arrival >= self.stop_time[dst]:
+            self.metrics["down_pkts"] += 1
+            return True
         if self.pending[dst] >= self.params.ev_cap:
             self.metrics["ev_overflow"] += 1
             return True
         self._push(arrival, packet_tb(src, ctr), dst, kind, p)
         self.metrics["pkts_delivered"] += 1
+        if self.capture is not None:
+            self.capture(arrival, src, dst, p, False)
         return True
 
     def _push(self, time: int, tb: int, host: int, kind: int, p: tuple) -> None:
@@ -124,8 +154,24 @@ class CpuEngine:
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
         end = (self.n_windows if n_windows is None else n_windows) * self.window
         while self.heap and self.heap[0][0] < end:
-            time, _tb, _g, host, kind, p = heapq.heappop(self.heap)
+            time, tb, _g, host, kind, p = heapq.heappop(self.heap)
             self.pending[host] -= 1
+            # churn: a stopped host discards its events (core run_round rule)
+            if self.has_stop and time >= self.stop_time[host]:
+                self.metrics["down_events"] += 1
+                continue
+            # virtual CPU (host/cpu.c): execute at eff = max(time, busy); an
+            # execution slipping past the window boundary re-queues at
+            # (eff, original tb) unexecuted — identical rule to run_round.
+            if self.has_cpu:
+                eff = max(time, int(self.cpu_busy[host]))
+                if eff >= (time // self.window + 1) * self.window:
+                    self.pending[host] += 1
+                    heapq.heappush(self.heap, (eff, tb, self._gseq, host, kind, p))
+                    self._gseq += 1
+                    continue
+                self.cpu_busy[host] = eff + int(self.cpu_cost[host])
+                time = eff
             self.metrics["events"] += 1
             self.model.handle(host, time, kind, p)
         return dict(self.metrics)

@@ -127,6 +127,15 @@ class CpuNetModel:
         self.rx_free = np.zeros(h, np.int64)
         self.tx_bytes = np.zeros(h, np.int64)
         self.rx_bytes = np.zeros(h, np.int64)
+        # Finite NIC queues (router.c drop-tail; mirror of net/nic.py).
+        from shadow1_tpu.core.engine import qlen_ns_np
+
+        self.tx_qlen_ns = qlen_ns_np(eng.exp.tx_qlen_bytes, eng.exp.bw_up)
+        self.rx_qlen_ns = qlen_ns_np(eng.exp.rx_qlen_bytes, eng.exp.bw_dn)
+        self.has_qlen = bool(
+            (np.asarray(eng.exp.tx_qlen_bytes).max() > 0)
+            or (np.asarray(eng.exp.rx_qlen_bytes).max() > 0)
+        )
         self.socks = [
             [CpuSock() for _ in range(self.pr.sockets_per_host)] for _ in range(h)
         ]
@@ -158,7 +167,11 @@ class CpuNetModel:
     # ------------------------------------------------------------------
     # NIC + packet emission (mirror of tcp.py _emit / net.udp_send)
     # ------------------------------------------------------------------
-    def _tx(self, host: int, wire: int, now: int) -> int:
+    def _tx(self, host: int, wire: int, now: int) -> int | None:
+        """Reserve the uplink; None = drop-tail (queue bound exceeded)."""
+        if self.has_qlen and (int(self.tx_free[host]) - now) > int(self.tx_qlen_ns[host]):
+            self.eng.metrics["nic_tx_drops"] += 1
+            return None
         depart = max(now, int(self.tx_free[host]))
         self.tx_free[host] = depart + ser_delay_ns(wire, int(self.eng.exp.bw_up[host]))
         self.tx_bytes[host] += wire
@@ -179,11 +192,15 @@ class CpuNetModel:
             0,
         )
         depart = self._tx(h, length + WIRE_OVERHEAD, now)
+        if depart is None:  # queue-dropped: behaves like loss, rtx recovers
+            return
         self.eng.send(h, k.peer_host, K_PKT, depart, p, now=now)
 
     def udp_send(self, h, dst_host, dst_sock, length, meta, meta2, now):
         p = (h, (dst_sock << 8) | (F_DGRAM << 16), 0, 0, length, 0, 0, meta, meta2, 0)
         depart = self._tx(h, length + WIRE_OVERHEAD, now)
+        if depart is None:
+            return
         self.eng.send(h, dst_host, K_PKT, depart, p, now=now)
 
     # ------------------------------------------------------------------
@@ -295,6 +312,9 @@ class CpuNetModel:
     def handle(self, host, time, kind, p):
         if kind == K_PKT:
             wire = p[4] + WIRE_OVERHEAD
+            if self.has_qlen and (int(self.rx_free[host]) - time) > int(self.rx_qlen_ns[host]):
+                self.eng.metrics["nic_rx_drops"] += 1  # downlink drop-tail
+                return
             ready = max(time, int(self.rx_free[host]))
             self.rx_free[host] = ready + ser_delay_ns(wire, int(self.eng.exp.bw_dn[host]))
             self.rx_bytes[host] += wire

@@ -1,0 +1,92 @@
+"""Structured simulation logger: levels, sim-time context, per-host records.
+
+The reference runs an async logger thread with per-thread buffers; every
+record carries sim time, wall time and host context, and verbosity is a CLI
+level (src/main/core/logger/shadow-logger.c, logrecord.c). The batched
+engine cannot log from inside a traced window, so the stream is emitted at
+chunk boundaries instead: engine-level records (heartbeats, drops) plus —
+at the configured tracker interval — one record per host with its counter
+snapshot (the Tracker stream, src/main/host/tracker.c).
+
+Records are JSON lines: ``{"t": <wall iso>, "sim_s": .., "level": ..,
+"host": .. | null, "msg": .., ...fields}``. A ``level`` filter plays the
+reference's --log-level flag.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+LEVELS = {"error": 40, "warning": 30, "message": 20, "info": 10, "debug": 0}
+
+
+class SimLogger:
+    """JSON-lines logger with level filtering and sim-time context."""
+
+    def __init__(self, stream=None, level: str = "message"):
+        self.stream = stream if stream is not None else sys.stderr
+        self.threshold = LEVELS[level]
+        self.t0 = time.perf_counter()
+        self.n_dropped = 0
+
+    def log(self, level: str, msg: str, sim_ns: int | None = None,
+            host: int | None = None, **fields) -> None:
+        if LEVELS[level] < self.threshold:
+            self.n_dropped += 1
+            return
+        rec = {
+            "wall_s": round(time.perf_counter() - self.t0, 3),
+            "level": level,
+            "msg": msg,
+        }
+        if sim_ns is not None:
+            rec["sim_s"] = round(sim_ns / 1e9, 6)
+        if host is not None:
+            rec["host"] = int(host)
+        rec.update(fields)
+        print(json.dumps(rec), file=self.stream, flush=True)
+
+    def error(self, msg, **kw):
+        self.log("error", msg, **kw)
+
+    def warning(self, msg, **kw):
+        self.log("warning", msg, **kw)
+
+    def message(self, msg, **kw):
+        self.log("message", msg, **kw)
+
+    def info(self, msg, **kw):
+        self.log("info", msg, **kw)
+
+    def debug(self, msg, **kw):
+        self.log("debug", msg, **kw)
+
+
+def tracker_records(engine, st) -> list[dict]:
+    """Per-host tracker snapshot (host/tracker.c heartbeat analogue).
+
+    Pulls the per-host counter columns off-device ONCE and emits one dict
+    per host: NIC byte counters, queued events, cpu busy-time, plus every
+    per-host column the model summary exposes. Counters are lifetime
+    absolutes; interval deltas are tools/heartbeat_report.py's job."""
+    import numpy as np
+
+    sim_ns = int(st.win_start)
+    cols: dict[str, np.ndarray] = {}
+    cols["pending_events"] = np.asarray(
+        (np.asarray(st.evbuf.kind) != 0).sum(axis=1)
+    )
+    cols["cpu_busy_ns"] = np.asarray(st.cpu_busy)
+    # Model summaries own their key namespace (net exports nic_tx_bytes /
+    # nic_rx_bytes per host; apps export their per-host counters).
+    for k, v in engine.model_summary(st).items():
+        v = np.asarray(v)
+        if v.ndim == 1 and v.shape[0] == engine.exp.n_hosts:
+            cols[k] = v
+    return [
+        {"type": "tracker", "sim_s": round(sim_ns / 1e9, 6), "host": h,
+         **{k: int(v[h]) for k, v in cols.items()}}
+        for h in range(engine.exp.n_hosts)
+    ]

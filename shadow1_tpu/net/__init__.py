@@ -86,14 +86,20 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     p = p.at[:, 7].set(jnp.asarray(meta, jnp.int32))
     p = p.at[:, 8].set(jnp.asarray(meta2, jnp.int32))
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
-    nic, depart = tx_stamp(st.model.nic, mask, wire, now, ctx.bw_up)
+    nic, depart, sent = tx_stamp(
+        st.model.nic, mask, wire, now, ctx.bw_up,
+        ctx.tx_qlen_ns if ctx.has_qlen else None,
+    )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
-    outbox, ok = outbox_append(st.outbox, mask, dst_host, k, depart, p)
+    outbox, ok = outbox_append(st.outbox, sent, dst_host, k, depart, p)
     m = st.metrics
     return st._replace(
         model=st.model._replace(nic=nic),
         outbox=outbox,
-        metrics=m._replace(ob_overflow=m.ob_overflow + (mask & ~ok).sum(dtype=jnp.int64)),
+        metrics=m._replace(
+            ob_overflow=m.ob_overflow + (sent & ~ok).sum(dtype=jnp.int64),
+            nic_tx_drops=m.nic_tx_drops + (mask & ~sent).sum(dtype=jnp.int64),
+        ),
     )
 
 
@@ -103,17 +109,24 @@ def make_handlers(ctx):
     app_on_wakeup = app_mod.on_wakeup
 
     def on_pkt(st, ev):
-        """K_PKT: packet reached the dst NIC — model the receive queue."""
+        """K_PKT: packet reached the dst NIC — model the receive queue
+        (drop-tail when the downlink queue bound is exceeded)."""
         m = ev.mask & (ev.kind == K_PKT)
         wire = jnp.asarray(ev.p[:, 4], jnp.int64) + WIRE_OVERHEAD
-        nic, ready = rx_stamp(st.model.nic, m, wire, ev.time, ctx.bw_dn)
+        nic, ready, okq = rx_stamp(
+            st.model.nic, m, wire, ev.time, ctx.bw_dn,
+            ctx.rx_qlen_ns if ctx.has_qlen else None,
+        )
         st = st._replace(model=st.model._replace(nic=nic))
         k = jnp.full(ctx.n_hosts, K_PKT_DELIVER, jnp.int32)
-        evbuf, over = push_local(st.evbuf, m, ready, k, ev.p)
+        evbuf, over = push_local(st.evbuf, okq, ready, k, ev.p)
         met = st.metrics
         return st._replace(
             evbuf=evbuf,
-            metrics=met._replace(ev_overflow=met.ev_overflow + over.sum(dtype=jnp.int64)),
+            metrics=met._replace(
+                ev_overflow=met.ev_overflow + over.sum(dtype=jnp.int64),
+                nic_rx_drops=met.nic_rx_drops + (m & ~okq).sum(dtype=jnp.int64),
+            ),
         )
 
     def on_deliver(st, ev):

@@ -36,8 +36,15 @@ def ser_delay(wire_bytes, bw_bits):
     return (w * (8 * SEC) + bw_bits - 1) // bw_bits
 
 
-def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up):
-    """Reserve the uplink: returns (nic', depart_time[H])."""
+def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up, qlen_ns=None):
+    """Reserve the uplink: returns (nic', depart_time[H], ok[H]).
+
+    With a finite queue (``qlen_ns``, the bound expressed as serialization
+    backlog time — src/main/routing/router.c's upstream drop-tail queue),
+    a packet is DROPPED (ok=False, link not reserved) when the backlog
+    already exceeds the bound."""
+    if qlen_ns is not None:
+        mask = mask & ((nic.tx_free - jnp.asarray(now, jnp.int64)) <= qlen_ns)
     depart = jnp.maximum(now, nic.tx_free)
     busy = depart + ser_delay(wire_bytes, bw_up)
     w = jnp.asarray(wire_bytes, jnp.int64)
@@ -47,12 +54,15 @@ def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up):
             tx_bytes=nic.tx_bytes + jnp.where(mask, w, 0),
         ),
         depart,
+        mask,
     )
 
 
-def rx_stamp(nic: NicState, mask, wire_bytes, now, bw_dn):
-    """Reserve the downlink: returns (nic', ready_time[H]) — the time the
-    packet clears the receive queue and may be processed."""
+def rx_stamp(nic: NicState, mask, wire_bytes, now, bw_dn, qlen_ns=None):
+    """Reserve the downlink: returns (nic', ready_time[H], ok[H]) — the time
+    the packet clears the receive queue; drop-tail like tx_stamp."""
+    if qlen_ns is not None:
+        mask = mask & ((nic.rx_free - jnp.asarray(now, jnp.int64)) <= qlen_ns)
     ready = jnp.maximum(now, nic.rx_free)
     busy = ready + ser_delay(wire_bytes, bw_dn)
     w = jnp.asarray(wire_bytes, jnp.int64)
@@ -62,4 +72,5 @@ def rx_stamp(nic: NicState, mask, wire_bytes, now, bw_dn):
             rx_bytes=nic.rx_bytes + jnp.where(mask, w, 0),
         ),
         ready,
+        mask,
     )

@@ -1,0 +1,100 @@
+"""Intra-host pipes — the local-IPC analogue of channel.c.
+
+The reference gives co-located processes pipes/socketpairs
+(src/main/host/descriptor/channel.c): a byte FIFO between two descriptors
+on one host, with readable/writable status feeding epoll. The tensor
+analogue keeps a fixed table of pipes per host as SoA columns — byte
+counts + a small message FIFO, exactly the modeling convention of the TCP
+stack (lengths + metas, no real bytes) — and delivery is the same-host
+self-event pattern every app layer already uses: the writer pushes a K_APP
+wakeup for the reader at ``now`` (the next round), the batch analogue of
+the descriptor-status → epoll → callback chain.
+
+FIFO order is carried by per-message sequence stamps (slot indices are
+storage, not order). All updates are dense one-hot ops (core/dense.py);
+everything is masked per host, so apps drive any subset of hosts per round.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from shadow1_tpu.core.dense import get_col, onehot_col
+
+_SEQ_MAX = jnp.int32(2**31 - 1)
+
+
+class PipeTable(NamedTuple):
+    buffered: jnp.ndarray  # i32 [H, P] bytes queued
+    mq_len: jnp.ndarray    # i32 [H, P, M] message lengths (0 = free slot)
+    mq_meta: jnp.ndarray   # i32 [H, P, M]
+    mq_seq: jnp.ndarray    # i32 [H, P, M] FIFO stamp of each message
+    next_seq: jnp.ndarray  # i32 [H, P]
+    written: jnp.ndarray   # i64 [H, P] lifetime bytes written
+    drained: jnp.ndarray   # i64 [H, P] lifetime bytes read
+
+
+def pipe_init(n_hosts: int, n_pipes: int, mq_cap: int = 8) -> PipeTable:
+    z32 = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+    return PipeTable(
+        buffered=z32(n_hosts, n_pipes),
+        mq_len=z32(n_hosts, n_pipes, mq_cap),
+        mq_meta=z32(n_hosts, n_pipes, mq_cap),
+        mq_seq=z32(n_hosts, n_pipes, mq_cap),
+        next_seq=z32(n_hosts, n_pipes),
+        written=jnp.zeros((n_hosts, n_pipes), jnp.int64),
+        drained=jnp.zeros((n_hosts, n_pipes), jnp.int64),
+    )
+
+
+def pipe_write(pt: PipeTable, mask, pipe, nbytes, meta, capacity: int):
+    """Write one message per host where ``mask``: accepted only if the
+    bytes fit ``capacity`` AND a message slot is free (all-or-nothing, like
+    tcp_send's boundary admission). Returns (pt, ok[H])."""
+    nbytes = jnp.asarray(nbytes, jnp.int32)
+    cur = get_col(pt.buffered, pipe)
+    fits = (cur + nbytes) <= capacity
+    mq = get_col(pt.mq_len, pipe)            # [H, M]
+    free = mq == 0
+    has_free = free.any(axis=1)
+    slot = jnp.argmax(free, axis=1).astype(jnp.int32)
+    ok = mask & fits & has_free
+    seq = get_col(pt.next_seq, pipe)
+    sel = onehot_col(pipe, pt.buffered.shape[1], ok)
+    sel3 = sel[:, :, None] & onehot_col(slot, pt.mq_len.shape[2])[:, None, :]
+    return pt._replace(
+        buffered=jnp.where(sel, cur[:, None] + nbytes[:, None], pt.buffered),
+        mq_len=jnp.where(sel3, nbytes[:, None, None], pt.mq_len),
+        mq_meta=jnp.where(sel3, jnp.asarray(meta, jnp.int32)[:, None, None],
+                          pt.mq_meta),
+        mq_seq=jnp.where(sel3, seq[:, None, None], pt.mq_seq),
+        next_seq=pt.next_seq + sel.astype(jnp.int32),
+        written=pt.written + jnp.where(sel, nbytes[:, None].astype(jnp.int64), 0),
+    ), ok
+
+
+def pipe_read(pt: PipeTable, mask, pipe):
+    """Read the OLDEST pending message of the pipe (min sequence stamp).
+    Returns (pt, got[H], nbytes[H], meta[H])."""
+    mq = get_col(pt.mq_len, pipe)             # [H, M]
+    pending = mq != 0
+    has = pending.any(axis=1)
+    seqs = jnp.where(pending, get_col(pt.mq_seq, pipe), _SEQ_MAX)
+    slot = jnp.argmin(seqs, axis=1).astype(jnp.int32)
+    got = mask & has
+    nbytes = jnp.where(got, get_col(mq, slot), 0)
+    meta = jnp.where(got, get_col(get_col(pt.mq_meta, pipe), slot), 0)
+    sel = onehot_col(pipe, pt.buffered.shape[1], got)
+    sel3 = sel[:, :, None] & onehot_col(slot, pt.mq_len.shape[2])[:, None, :]
+    return pt._replace(
+        buffered=jnp.where(sel, pt.buffered - nbytes[:, None], pt.buffered),
+        mq_len=jnp.where(sel3, 0, pt.mq_len),
+        drained=pt.drained + jnp.where(sel, nbytes[:, None].astype(jnp.int64), 0),
+    ), got, nbytes, meta
+
+
+def pipe_readable(pt: PipeTable, pipe):
+    """bool [H]: the pipe has a pending message."""
+    return (get_col(pt.mq_len, pipe) != 0).any(axis=1)

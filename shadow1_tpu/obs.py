@@ -65,9 +65,17 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
 
     Returns (final_state, heartbeat) — heartbeat.records holds the stream.
     """
+    import jax
+
     total = n_windows if n_windows is not None else engine.n_windows
     if every_windows is None:
         every_windows = max(total // 10, 1)
+    if st is None:
+        st = engine.init_state()
+    # Compile before the clock starts: n_windows is a traced argument, so a
+    # zero-window call builds the exact program every chunk reuses — the
+    # first heartbeat's events/sec no longer folds compile time in.
+    jax.block_until_ready(engine.run(st, n_windows=0))
     hb = Heartbeat(engine, stream=stream, initial_state=st)
     st = run_chunked(engine, st, n_windows=total, chunk=every_windows, on_chunk=hb)
     return st, hb

@@ -152,7 +152,8 @@ def randint(b: jax.Array, n) -> jax.Array:
     """u32 bits → integer in [0, n) via 64-bit multiply-shift (exact, no bias
     for n ≪ 2^32 beyond the standard multiply-shift approximation; identical
     in both engines)."""
-    return ((b.astype(jnp.uint64) * jnp.uint64(n)) >> jnp.uint64(32)).astype(jnp.int32)
+    n = jnp.asarray(n).astype(jnp.uint64)  # scalar or per-element array
+    return ((b.astype(jnp.uint64) * n) >> jnp.uint64(32)).astype(jnp.int32)
 
 
 # --------------------------------------------------------------------------

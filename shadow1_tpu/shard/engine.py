@@ -49,6 +49,7 @@ from shadow1_tpu.core.engine import (
     SimState,
     _metrics_init,
     _model_module,
+    fidelity_ctx_kwargs,
     window_step,
 )
 from shadow1_tpu.core.events import _hi, _join, _lo, evbuf_init
@@ -97,9 +98,11 @@ class ShardedEngine:
             bw_up=jnp.asarray(exp.bw_up, jnp.int64),
             bw_dn=jnp.asarray(exp.bw_dn, jnp.int64),
             model_cfg=exp.model_cfg,
+            **fidelity_ctx_kwargs(exp),
         )
         self._model = _model_module(exp.model)
-        self._run_jit = jax.jit(self._make_run(), static_argnums=1)
+        # n_windows traced: one compiled program for every window count.
+        self._run_jit = jax.jit(self._make_run())
 
     # -- sharding specs ----------------------------------------------------
     def _spec_for(self, leaf) -> P:
@@ -124,6 +127,7 @@ class ShardedEngine:
             outbox=outbox_init(self.exp.n_hosts, self.params.outbox_cap),
             model=model,
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
+            cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
         )
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self._state_specs(st)
@@ -140,16 +144,25 @@ class ShardedEngine:
         loss_vv = self.global_ctx.loss_vv
         loss_thr_vv = self.global_ctx.loss_thr_vv
         host_vertex = self.global_ctx.host_vertex  # full, replicated
-        hosts_g = self.global_ctx.hosts
-        bw_up_g = self.global_ctx.bw_up
-        bw_dn_g = self.global_ctx.bw_dn
+        gctx = self.global_ctx
+        # Per-host columns sharded alongside the state (P(axis) each).
+        cols_g = dict(
+            hosts=gctx.hosts, bw_up=gctx.bw_up, bw_dn=gctx.bw_dn,
+            stop_time=gctx.stop_time, cpu_cost=gctx.cpu_cost,
+            tx_qlen_ns=gctx.tx_qlen_ns, rx_qlen_ns=gctx.rx_qlen_ns,
+        )
+        flags = dict(
+            has_jitter=gctx.has_jitter, has_stop=gctx.has_stop,
+            has_cpu=gctx.has_cpu, has_qlen=gctx.has_qlen,
+        )
+        jitter_vv = gctx.jitter_vv
 
         # Per-(src→dst shard) bucket capacity: explicit knob or 2× the
         # uniform-traffic expectation (N_local / n_dev), min 16.
         n_local = h_local * pr.outbox_cap
         x2x_cap = pr.x2x_cap or max(16, -(-2 * n_local // n_dev))
 
-        def block(st: SimState, hosts, bw_up, bw_dn, n_windows: int) -> SimState:
+        def block(st: SimState, cols, n_windows) -> SimState:
             ctx = Ctx(
                 n_hosts=h_local,
                 n_total=exp.n_hosts,
@@ -159,11 +172,17 @@ class ShardedEngine:
                 lat_vv=lat_vv,
                 loss_vv=loss_vv,
                 host_vertex=host_vertex,
-                bw_up=bw_up,
-                bw_dn=bw_dn,
+                bw_up=cols["bw_up"],
+                bw_dn=cols["bw_dn"],
                 model_cfg=exp.model_cfg,
-                hosts=hosts,
+                hosts=cols["hosts"],
                 loss_thr_vv=loss_thr_vv,
+                jitter_vv=jitter_vv,
+                stop_time=cols["stop_time"],
+                cpu_cost=cols["cpu_cost"],
+                tx_qlen_ns=cols["tx_qlen_ns"],
+                rx_qlen_ns=cols["rx_qlen_ns"],
+                **flags,
             )
             handlers = model.make_handlers(ctx)
 
@@ -235,16 +254,17 @@ class ShardedEngine:
             # win_start) — keep the local count rather than the 8× sum.
             return st._replace(metrics=mfin._replace(windows=st.metrics.windows))
 
-        def run(st: SimState, n_windows: int) -> SimState:
+        def run(st: SimState, n_windows) -> SimState:
             specs = self._state_specs(st)
+            col_specs = {k: P(axis) for k in cols_g}
             f = jax.shard_map(
-                lambda s, h, bu, bd: block(s, h, bu, bd, n_windows),
+                block,
                 mesh=self.mesh,
-                in_specs=(specs, P(axis), P(axis), P(axis)),
+                in_specs=(specs, col_specs, P()),
                 out_specs=specs,
                 check_vma=False,
             )
-            return f(st, hosts_g, bw_up_g, bw_dn_g)
+            return f(st, cols_g, n_windows)
 
         return run
 
@@ -253,7 +273,8 @@ class ShardedEngine:
             check_x2x: bool = True) -> SimState:
         if st is None:
             st = self.init_state()
-        st = self._run_jit(st, n_windows if n_windows is not None else self.n_windows)
+        n = n_windows if n_windows is not None else self.n_windows
+        st = self._run_jit(st, jnp.asarray(n, jnp.int32))
         if check_x2x:
             # Loud failure beats silently-wrong results: a full all_to_all
             # bucket means packets vanished and single-device parity is

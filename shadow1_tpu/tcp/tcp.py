@@ -168,10 +168,25 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
     p = p.at[:, 6].set(mend)
     p = p.at[:, 7].set(mmeta)
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
-    nic, depart = tx_stamp(st.model.nic, mask, wire, now, ctx.bw_up)
+    nic, depart, sent = tx_stamp(
+        st.model.nic, mask, wire, now, ctx.bw_up,
+        ctx.tx_qlen_ns if ctx.has_qlen else None,
+    )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
-    outbox, ok = outbox_append(st.outbox, mask, r.g("peer_host"), k, depart, p)
-    return st._replace(model=st.model._replace(nic=nic), outbox=outbox)
+    # A queue-dropped segment behaves exactly like path loss: sequence state
+    # advanced, packet never routed — retransmission recovers it.
+    outbox, ok = outbox_append(st.outbox, sent, r.g("peer_host"), k, depart, p)
+    m = st.metrics
+    return st._replace(
+        model=st.model._replace(nic=nic), outbox=outbox,
+        metrics=m._replace(
+            nic_tx_drops=m.nic_tx_drops + (mask & ~sent).sum(dtype=jnp.int64),
+            # tcp_flush checks outbox_space before every segment, so this
+            # "cannot" fire — but a vanishing segment with no counter would
+            # be the worst possible failure mode, and the oracle counts it.
+            ob_overflow=m.ob_overflow + (sent & ~ok).sum(dtype=jnp.int64),
+        ),
+    )
 
 
 from shadow1_tpu.core.engine import push_local_event as _push_local  # noqa: E402

@@ -1,0 +1,102 @@
+"""Heartbeat/tracker log analysis — the tools/ plotting-scripts analogue.
+
+The reference ships helper scripts that parse heartbeat logs into
+throughput/RTT tables and plots (SURVEY §2.6 tools/). This reads the JSON
+lines the CLI emits (--heartbeat → engine heartbeats on stderr; --tracker →
+per-host records) and prints summary tables plus an optional CSV for
+plotting.
+
+    python -m shadow1_tpu.tools.heartbeat_report run.log [--csv out.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+
+
+def load_records(path: str) -> list[dict]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return recs
+
+
+def summarize(recs: list[dict], out=sys.stdout) -> dict:
+    hb = [r for r in recs if r.get("type") == "heartbeat"]
+    tr = [r for r in recs if r.get("type") == "tracker"]
+    summary: dict = {"heartbeats": len(hb), "tracker_records": len(tr)}
+    if hb:
+        eps = [r["events_per_sec"] for r in hb if r.get("events_per_sec")]
+        spw = [r["sim_per_wall"] for r in hb if r.get("sim_per_wall")]
+        summary.update(
+            sim_time_s=hb[-1]["sim_time_s"],
+            wall_s=hb[-1]["wall_s"],
+            events=sum(r["delta"]["events"] for r in hb),
+            events_per_sec_mean=round(sum(eps) / len(eps), 1) if eps else None,
+            sim_per_wall_mean=round(sum(spw) / len(spw), 4) if spw else None,
+            pkts_delivered=sum(r["delta"].get("pkts_delivered", 0) for r in hb),
+            retransmits=sum(
+                r["delta"].get("tcp_rto", 0) + r["delta"].get("tcp_fast_rtx", 0)
+                for r in hb
+            ),
+        )
+        print("== run summary ==", file=out)
+        for k, v in summary.items():
+            print(f"  {k}: {v}", file=out)
+    if tr:
+        last_per_host: dict[int, dict] = {}
+        for r in tr:
+            last_per_host[r["host"]] = r
+        tx = sorted(
+            last_per_host.values(), key=lambda r: -r.get("nic_tx_bytes", 0)
+        )[:10]
+        print("== top talkers (final tracker snapshot) ==", file=out)
+        for r in tx:
+            print(
+                f"  host {r['host']}: tx {r.get('nic_tx_bytes', 0)} B, "
+                f"rx {r.get('nic_rx_bytes', 0)} B, "
+                f"pending {r.get('pending_events', 0)}",
+                file=out,
+            )
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.heartbeat_report")
+    ap.add_argument("log")
+    ap.add_argument("--csv", default=None,
+                    help="write the heartbeat series as CSV for plotting")
+    args = ap.parse_args(argv)
+    recs = load_records(args.log)
+    if not recs:
+        print("no JSON records found", file=sys.stderr)
+        return 1
+    summarize(recs)
+    if args.csv:
+        hb = [r for r in recs if r.get("type") == "heartbeat"]
+        with open(args.csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["sim_time_s", "wall_s", "events_per_sec",
+                        "sim_per_wall", "events", "pkts_delivered"])
+            for r in hb:
+                w.writerow([
+                    r["sim_time_s"], r["wall_s"], r.get("events_per_sec"),
+                    r.get("sim_per_wall"), r["delta"]["events"],
+                    r["delta"].get("pkts_delivered", 0),
+                ])
+        print(f"wrote {args.csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

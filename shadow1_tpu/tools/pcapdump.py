@@ -1,0 +1,40 @@
+"""pcap dump CLI: run an experiment on the fidelity (oracle) engine and
+capture every delivered packet to a .pcap file.
+
+    python -m shadow1_tpu.tools.pcapdump config.yaml out.pcap [--windows N]
+
+The capture engine is the sequential oracle (it sees every packet at
+routing time); for large configs bound the run with --windows.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.pcapdump")
+    ap.add_argument("config")
+    ap.add_argument("out")
+    ap.add_argument("--windows", type=int, default=None)
+    ap.add_argument("--snaplen", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import force_cpu
+
+    force_cpu(1)  # the oracle needs no accelerator
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.cpu_engine import CpuEngine
+    from shadow1_tpu.tools.pcap import PcapWriter
+
+    exp, params, _ = load_experiment(args.config)
+    with PcapWriter(args.out, snaplen=args.snaplen) as w:
+        eng = CpuEngine(exp, params, capture=w)
+        m = eng.run(n_windows=args.windows)
+        print(f"{w.n_packets} packets captured to {args.out}; metrics: {m}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,15 +7,14 @@ imports anywhere.
 """
 
 import os
-import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
+# Package import path comes from pyproject.toml [tool.pytest.ini_options]
+# pythonpath — no sys.path surgery here.
 import shadow1_tpu  # noqa: E402,F401  (enables x64 before any jax array exists)
 import jax  # noqa: E402
 

@@ -1,0 +1,152 @@
+"""Aux subsystems: pipes, DNS registry, pcap capture, logger, tools."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow1_tpu.config.experiment import build_experiment
+from shadow1_tpu.consts import MS, SEC
+from shadow1_tpu.net.pipe import pipe_init, pipe_read, pipe_readable, pipe_write
+
+
+def test_pipe_fifo_and_capacity():
+    h = 4
+    pt = pipe_init(h, n_pipes=2, mq_cap=2)
+    allh = jnp.ones(h, bool)
+    p0 = jnp.zeros(h, jnp.int32)
+    # two writes FIFO
+    pt, ok1 = pipe_write(pt, allh, p0, jnp.full(h, 10, jnp.int32),
+                         jnp.full(h, 111, jnp.int32), capacity=64)
+    pt, ok2 = pipe_write(pt, allh, p0, jnp.full(h, 20, jnp.int32),
+                         jnp.full(h, 222, jnp.int32), capacity=64)
+    assert bool(ok1.all()) and bool(ok2.all())
+    assert bool(pipe_readable(pt, p0).all())
+    # mq full (cap 2): third write refused
+    pt, ok3 = pipe_write(pt, allh, p0, jnp.full(h, 5, jnp.int32),
+                         jnp.full(h, 333, jnp.int32), capacity=64)
+    assert not bool(ok3.any())
+    # reads come back in write order — including after slot reuse
+    pt, got, n, m = pipe_read(pt, allh, p0)
+    assert bool(got.all()) and int(n[0]) == 10 and int(m[0]) == 111
+    pt, ok4 = pipe_write(pt, allh, p0, jnp.full(h, 30, jnp.int32),
+                         jnp.full(h, 444, jnp.int32), capacity=64)
+    assert bool(ok4.all())
+    pt, got, n, m = pipe_read(pt, allh, p0)
+    assert int(n[0]) == 20 and int(m[0]) == 222  # FIFO survives slot reuse
+    pt, got, n, m = pipe_read(pt, allh, p0)
+    assert int(n[0]) == 30 and int(m[0]) == 444
+    pt, got, n, m = pipe_read(pt, allh, p0)
+    assert not bool(got.any())
+    # byte-capacity refusal
+    pt, okbig = pipe_write(pt, allh, p0, jnp.full(h, 100, jnp.int32),
+                           jnp.full(h, 1, jnp.int32), capacity=64)
+    assert not bool(okbig.any())
+    assert int(pt.written[0, 0]) == 60 and int(pt.drained[0, 0]) == 60
+
+
+def _doc():
+    return {
+        "general": {"seed": 3, "stop_time": "2 s"},
+        "engine": {"scheduler": "cpu"},
+        "hosts": [
+            {"name": "server", "count": 1},
+            {"name": "client", "count": 3},
+        ],
+        "app": {
+            "model": "filexfer",
+            "groups": {
+                "server": {"role": 0},
+                "client": {"role": 1, "server": "@server", "flow_bytes": 2000,
+                           "flow_count": 1, "start_time": "1 ms"},
+            },
+        },
+    }
+
+
+def test_dns_registry():
+    exp, _, _ = build_experiment(_doc())
+    dns = exp.dns
+    assert dns.resolve("server") == 0
+    assert dns.resolve("client-0") == 1 and dns.resolve("client-2") == 3
+    assert dns.resolve("client") == 1  # bare group name = first host
+    assert dns.reverse(0) == "server" and dns.reverse(3) == "client-2"
+    assert dns.vertex_of(2) == 0
+    assert len(dns) == 4
+    with pytest.raises(KeyError):
+        dns.resolve("nonexistent")
+
+
+def test_pcap_capture(tmp_path):
+    from shadow1_tpu.cpu_engine import CpuEngine
+    from shadow1_tpu.tools.pcap import PcapWriter
+
+    exp, params, _ = build_experiment(_doc())
+    out = tmp_path / "cap.pcap"
+    with PcapWriter(str(out)) as w:
+        CpuEngine(exp, params, capture=w).run()
+        n = w.n_packets
+    assert n > 10
+    data = out.read_bytes()
+    import struct
+
+    magic, _vmaj, _vmin, _tz, _sig, snaplen, linktype = struct.unpack(
+        "<IHHiIII", data[:24]
+    )
+    assert magic == 0xA1B2C3D4 and linktype == 101
+    # walk every record; verify IPv4 headers and count
+    off, count = 24, 0
+    while off < len(data):
+        _ts, _us, incl, _orig = struct.unpack("<IIII", data[off:off + 16])
+        assert incl <= snaplen
+        pkt = data[off + 16: off + 16 + incl]
+        assert pkt[0] == 0x45  # IPv4, IHL 5
+        off += 16 + incl
+        count += 1
+    assert count == n
+
+
+def test_sim_logger_levels(capsys):
+    import io
+
+    from shadow1_tpu.log import SimLogger
+
+    buf = io.StringIO()
+    log = SimLogger(stream=buf, level="message")
+    log.debug("hidden")
+    log.message("shown", sim_ns=5 * MS, host=3, extra=1)
+    log.error("boom")
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert len(lines) == 2 and log.n_dropped == 1
+    assert lines[0]["msg"] == "shown" and lines[0]["host"] == 3
+    assert lines[0]["sim_s"] == 0.005 and lines[0]["extra"] == 1
+
+
+def test_tracker_records_and_report(tmp_path, capsys):
+    from shadow1_tpu.core.engine import Engine
+    from shadow1_tpu.log import tracker_records
+    from shadow1_tpu.tools.heartbeat_report import load_records, summarize
+
+    exp, params, _ = build_experiment(_doc())
+    eng = Engine(exp, params)
+    st = eng.run()
+    recs = tracker_records(eng, st)
+    assert len(recs) == 4
+    assert recs[1]["nic_rx_bytes"] > 0 and recs[0]["nic_tx_bytes"] > 0
+    assert recs[0]["rx_bytes"] > 0  # app-level bytes at the server
+    assert all("flows_done" in r for r in recs)
+    # heartbeat_report consumes a mixed log of heartbeats + tracker records
+    log = tmp_path / "run.log"
+    hb = {"type": "heartbeat", "sim_time_s": 2.0, "wall_s": 1.0,
+          "windows": 100, "events_per_sec": 50.0, "sim_per_wall": 2.0,
+          "delta": {"events": 50, "windows": 100, "pkts_delivered": 30}}
+    with open(log, "w") as f:
+        f.write(json.dumps(hb) + "\n")
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    got = load_records(str(log))
+    assert len(got) == 5
+    s = summarize(got)
+    assert s["heartbeats"] == 1 and s["tracker_records"] == 4
+    assert s["events"] == 50

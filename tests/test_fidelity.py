@@ -1,0 +1,102 @@
+"""Fidelity-knob parity: jitter, churn, virtual CPU, finite NIC queues.
+
+Each knob is exercised with a value that actually fires (jitter shifts
+arrivals, hosts stop mid-run, CPU busy-time defers executions, queues
+drop), and the batched engine must still match the CPU oracle exactly —
+the knobs are deterministic model features, not noise (SURVEY §5 fault
+injection; reference: topology edge jitter, config churn,
+src/main/routing/router.c drop-tail, src/main/host/cpu.c).
+"""
+
+import numpy as np
+
+from shadow1_tpu.config.compiled import NO_STOP, single_vertex_experiment
+from shadow1_tpu.consts import MS, SEC, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+
+KEYS = [
+    "events", "pkts_sent", "pkts_delivered", "pkts_lost",
+    "ev_overflow", "ob_overflow", "down_events", "down_pkts",
+    "nic_tx_drops", "nic_rx_drops",
+]
+
+
+def _both(exp, params=None):
+    params = params or EngineParams()
+    cm = CpuEngine(exp, params).run()
+    st = Engine(exp, params).run()
+    tm = Engine.metrics_dict(st)
+    for k in KEYS:
+        assert tm[k] == cm[k], (k, tm[k], cm[k])
+    return tm
+
+
+def _phold(n=64, end=80 * MS, **kw):
+    return single_vertex_experiment(
+        n_hosts=n, seed=5, end_time=end, latency_ns=2 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(3 * MS), "init_events": 2}, **kw,
+    )
+
+
+def test_jitter_parity():
+    base = _phold()
+    jit = _phold(jitter_ns=1 * MS)
+    assert jit.window == 1 * MS and base.window == 2 * MS  # runahead shrinks
+    m0 = _both(base)
+    m1 = _both(jit)
+    assert m1["events"] > 0 and m1["events"] != m0["events"]  # jitter acted
+
+
+def test_churn_stop_time_parity():
+    stop = np.full(64, NO_STOP, np.int64)
+    stop[::2] = 30 * MS  # half the hosts die mid-run
+    m = _both(_phold(stop_time=stop))
+    assert m["down_events"] > 0
+    assert m["down_pkts"] > 0
+    mfull = _both(_phold())
+    assert m["events"] < mfull["events"]
+
+
+def test_virtual_cpu_parity():
+    cost = np.zeros(64, np.int64)
+    cost[:32] = 500_000  # 0.5 ms of virtual CPU per event on half the hosts
+    m = _both(_phold(cpu_ns_per_event=cost))
+    mfree = _both(_phold())
+    # busy hosts serialize their events: fewer hops fit in the same sim time
+    assert m["events"] < mfree["events"]
+
+
+def _filexfer(n=6, qlen=0):
+    role = np.full(n, 1, np.int64)
+    role[0] = 0
+    fid = {}
+    if qlen:
+        fid = {"tx_qlen_bytes": np.full(n, qlen, np.int64),
+               "rx_qlen_bytes": np.full(n, qlen, np.int64)}
+    return single_vertex_experiment(
+        n_hosts=n, seed=9, end_time=30 * SEC, latency_ns=10 * MS,
+        bw_bits=10**6, model="net",
+        model_cfg={
+            "app": "filexfer",
+            "role": role,
+            "server": np.zeros(n, np.int64),
+            "flow_bytes": np.full(n, 60_000, np.int64),
+            "start_time": np.full(n, 1 * MS, np.int64),
+            "flow_count": np.where(role == 1, 1, 0),
+        },
+        **fid,
+    )
+
+
+def test_nic_queue_drops_parity():
+    """Tiny drop-tail NIC queues on a slow shared server link: drops fire,
+    engines agree exactly, and TCP retransmission still completes flows."""
+    params = EngineParams(ev_cap=256)
+    m = _both(_filexfer(qlen=3000), params)
+    assert m["nic_tx_drops"] + m["nic_rx_drops"] > 0
+    eng = Engine(_filexfer(qlen=3000), params)
+    st = eng.run()
+    s = eng.model_summary(st)
+    assert int(s["total_flows_done"]) == 5  # all flows survive the drops
