@@ -35,6 +35,10 @@ def main(argv=None) -> int:
                     help="resume from a state snapshot (batched engines)")
     ap.add_argument("--tracker", default=None, metavar="PATH",
                     help="write final per-host tracker records (JSON lines)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the run into DIR "
+                         "(open with TensorBoard; reference: heartbeat/"
+                         "tracker profiling hooks, SURVEY §5)")
     ap.add_argument("--log-level", default="message",
                     choices=["error", "warning", "message", "info", "debug"],
                     help="stderr log verbosity (reference --log-level analogue)")
@@ -55,9 +59,10 @@ def main(argv=None) -> int:
     else:
         ensure_live_platform(min_devices=1)
     if engine_kind == "cpu" and (args.save_state or args.resume
-                                 or args.heartbeat or args.tracker):
-        ap.error("--save-state/--resume/--heartbeat/--tracker require a "
-                 "batched engine (tpu or sharded)")
+                                 or args.heartbeat or args.tracker
+                                 or args.profile):
+        ap.error("--save-state/--resume/--heartbeat/--tracker/--profile "
+                 "require a batched engine (tpu or sharded)")
     from shadow1_tpu.log import SimLogger
 
     log = SimLogger(level=args.log_level)
@@ -92,15 +97,20 @@ def main(argv=None) -> int:
                 # after the checkpoint, not n_windows again on top of it.
                 done = int(st.win_start) // exp.window
                 args.windows = max(eng.n_windows - done, 0)
-        if args.heartbeat:
-            from shadow1_tpu.obs import run_with_heartbeat
+        import contextlib
 
-            st, _hb = run_with_heartbeat(
-                eng, st, n_windows=args.windows, every_windows=args.heartbeat
-            )
-        else:
-            st = eng.run(st, n_windows=args.windows)
-        jax.block_until_ready(st)
+        prof = (jax.profiler.trace(args.profile) if args.profile
+                else contextlib.nullcontext())
+        with prof:
+            if args.heartbeat:
+                from shadow1_tpu.obs import run_with_heartbeat
+
+                st, _hb = run_with_heartbeat(
+                    eng, st, n_windows=args.windows, every_windows=args.heartbeat
+                )
+            else:
+                st = eng.run(st, n_windows=args.windows)
+            jax.block_until_ready(st)
         if args.save_state:
             from shadow1_tpu.ckpt import save_state
 

@@ -46,6 +46,13 @@ class CompiledExperiment:
     cpu_ns_per_event: np.ndarray | None = None  # i64 [H] virtual CPU cost
     tx_qlen_bytes: np.ndarray | None = None     # i64 [H] NIC up-queue, 0=inf
     rx_qlen_bytes: np.ndarray | None = None     # i64 [H] NIC down-queue, 0=inf
+    # RED AQM on the uplink queue (router.c's upstream active queue
+    # management, behind a per-group flag): early-drop probability ramps
+    # linearly 0→pmax as the instantaneous backlog crosses [min, max) bytes,
+    # certain drop at ≥ max. aqm_max_bytes == 0 disables (the default).
+    aqm_min_bytes: np.ndarray | None = None     # i64 [H]
+    aqm_max_bytes: np.ndarray | None = None     # i64 [H], 0 = AQM off
+    aqm_pmax: np.ndarray | None = None          # f64 [H] drop prob at max
     # Host-side name registry (config/dns.py); None for programmatic
     # experiments (ids only). Never enters device state.
     dns: Any = None
@@ -62,6 +69,12 @@ class CompiledExperiment:
             self.tx_qlen_bytes = np.zeros(h, z)
         if self.rx_qlen_bytes is None:
             self.rx_qlen_bytes = np.zeros(h, z)
+        if self.aqm_min_bytes is None:
+            self.aqm_min_bytes = np.zeros(h, z)
+        if self.aqm_max_bytes is None:
+            self.aqm_max_bytes = np.zeros(h, z)
+        if self.aqm_pmax is None:
+            self.aqm_pmax = np.zeros(h, np.float64)
 
     @property
     def window(self) -> int:
@@ -84,6 +97,14 @@ class CompiledExperiment:
         assert (self.stop_time > 0).all()
         assert (self.cpu_ns_per_event >= 0).all()
         assert (self.tx_qlen_bytes >= 0).all() and (self.rx_qlen_bytes >= 0).all()
+        on = self.aqm_max_bytes > 0
+        assert (self.aqm_min_bytes >= 0).all()
+        assert (self.aqm_min_bytes[on] < self.aqm_max_bytes[on]).all(), (
+            "RED needs aqm_min_bytes < aqm_max_bytes where enabled"
+        )
+        assert ((self.aqm_pmax[on] > 0) & (self.aqm_pmax[on] <= 1)).all(), (
+            "RED needs 0 < aqm_pmax <= 1 where enabled"
+        )
         assert self.end_time > 0
 
 

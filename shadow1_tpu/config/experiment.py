@@ -90,6 +90,9 @@ class HostGroup:
     cpu_ns_per_event: int
     tx_qlen_bytes: int  # NIC uplink queue bound (0 = unbounded)
     rx_qlen_bytes: int
+    aqm_min_bytes: int  # RED uplink AQM thresholds (aqm_max_bytes 0 = off)
+    aqm_max_bytes: int
+    aqm_pmax: float
 
     @property
     def ids(self) -> np.ndarray:
@@ -158,6 +161,9 @@ def _expand_hosts(spec: list[dict]) -> list[HostGroup]:
             ),
             tx_qlen_bytes=int(g.get("tx_queue_bytes", 0)),
             rx_qlen_bytes=int(g.get("rx_queue_bytes", 0)),
+            aqm_min_bytes=int(g.get("aqm_min_bytes", 0)),
+            aqm_max_bytes=int(g.get("aqm_max_bytes", 0)),
+            aqm_pmax=float(g.get("aqm_pmax", 0.1)),
         ))
         start += count
     return groups
@@ -272,6 +278,9 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
     cpu_ns = np.zeros(h, np.int64)
     tx_qlen = np.zeros(h, np.int64)
     rx_qlen = np.zeros(h, np.int64)
+    aqm_min = np.zeros(h, np.int64)
+    aqm_max = np.zeros(h, np.int64)
+    aqm_pmax = np.zeros(h, np.float64)
     for g in groups:
         bw_up[g.ids] = g.bw_up
         bw_dn[g.ids] = g.bw_dn
@@ -279,6 +288,9 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         cpu_ns[g.ids] = g.cpu_ns_per_event
         tx_qlen[g.ids] = g.tx_qlen_bytes
         rx_qlen[g.ids] = g.rx_qlen_bytes
+        aqm_min[g.ids] = g.aqm_min_bytes
+        aqm_max[g.ids] = g.aqm_max_bytes
+        aqm_pmax[g.ids] = g.aqm_pmax if g.aqm_max_bytes else 0.0
 
     # -- app ---------------------------------------------------------------
     appsec = doc.get("app", {"model": "phold"})
@@ -345,6 +357,9 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         cpu_ns_per_event=cpu_ns,
         tx_qlen_bytes=tx_qlen,
         rx_qlen_bytes=rx_qlen,
+        aqm_min_bytes=aqm_min,
+        aqm_max_bytes=aqm_max,
+        aqm_pmax=aqm_pmax,
         dns=Dns.from_groups(groups, host_vertex),
     )
     exp.validate()

@@ -87,6 +87,7 @@ R_APP = 4
 R_TOR_PATH = 5
 R_BTC = 6
 R_JITTER = 7  # per-packet edge-latency jitter (ctr = src pkt counter)
+R_AQM = 8     # RED early-drop coin (ctr = per-host uplink attempt counter)
 
 
 @dataclasses.dataclass(frozen=True)

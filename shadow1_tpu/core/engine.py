@@ -61,6 +61,7 @@ class Metrics(NamedTuple):
     down_pkts: jnp.ndarray       # packets dropped: destination host stopped
     nic_tx_drops: jnp.ndarray    # packets dropped: NIC uplink queue full
     nic_rx_drops: jnp.ndarray    # packets dropped: NIC downlink queue full
+    nic_aqm_drops: jnp.ndarray   # packets dropped: RED early-drop (uplink)
 
 
 def _metrics_init() -> Metrics:
@@ -111,10 +112,14 @@ class Ctx:
     cpu_cost: jax.Array = None     # i64 [H] virtual CPU ns per event
     tx_qlen_ns: jax.Array = None   # i64 [H] uplink queue bound (ns of backlog)
     rx_qlen_ns: jax.Array = None   # i64 [H]
+    aqm_min_ns: jax.Array = None   # i64 [H] RED min threshold (backlog ns)
+    aqm_span_ns: jax.Array = None  # i64 [H] RED max − min (≥1 where enabled)
+    aqm_pmax_thr: jax.Array = None # u64 [H] Bernoulli threshold at pmax
     has_jitter: bool = False
     has_stop: bool = False
     has_cpu: bool = False
     has_qlen: bool = False
+    has_aqm: bool = False
 
     def __post_init__(self):
         if self.hosts is None:
@@ -369,21 +374,51 @@ def qlen_ns_np(qlen_bytes: np.ndarray, bw_bits: np.ndarray) -> np.ndarray:
     return np.where(q > 0, (q * 8 * SEC + bw - 1) // bw, _QLEN_INF)
 
 
+def aqm_tables_np(exp) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """RED per-host tables (min_ns, span_ns, pmax_thr) — computed ONCE here,
+    in numpy, and consumed by both engines so their drop decisions compare
+    identical integers. Thresholds convert bytes → uplink backlog-time ns
+    (same ceil math as the drop-tail bound, but 0 bytes means 0 ns here, not
+    "unbounded"); span is clamped ≥1 so the traced division is always safe;
+    pmax_thr is 0 wherever AQM is off."""
+    from shadow1_tpu.consts import SEC
+
+    bw = np.asarray(exp.bw_up, np.int64)
+
+    def to_ns(b):
+        return (np.asarray(b, np.int64) * 8 * SEC + bw - 1) // bw
+
+    min_ns = to_ns(exp.aqm_min_bytes)
+    max_ns = to_ns(exp.aqm_max_bytes)
+    on = np.asarray(exp.aqm_max_bytes) > 0
+    min_ns = np.where(on, min_ns, 0)
+    span_ns = np.maximum(np.where(on, max_ns - min_ns, 1), 1)
+    from shadow1_tpu import rng
+
+    pmax_thr = np.where(on, rng.prob_threshold(exp.aqm_pmax), np.uint64(0))
+    return min_ns.astype(np.int64), span_ns.astype(np.int64), pmax_thr
+
+
 def fidelity_ctx_kwargs(exp) -> dict:
     """The Ctx fidelity fields + static has_* flags from a CompiledExperiment
     (shared by Engine and ShardedEngine; everything numpy → device const)."""
     from shadow1_tpu.config.compiled import NO_STOP
 
+    aqm_min_ns, aqm_span_ns, aqm_pmax_thr = aqm_tables_np(exp)
     return dict(
         jitter_vv=jnp.asarray(exp.jitter_vv, jnp.int64),
         stop_time=jnp.asarray(exp.stop_time, jnp.int64),
         cpu_cost=jnp.asarray(exp.cpu_ns_per_event, jnp.int64),
         tx_qlen_ns=jnp.asarray(qlen_ns_np(exp.tx_qlen_bytes, exp.bw_up)),
         rx_qlen_ns=jnp.asarray(qlen_ns_np(exp.rx_qlen_bytes, exp.bw_dn)),
+        aqm_min_ns=jnp.asarray(aqm_min_ns),
+        aqm_span_ns=jnp.asarray(aqm_span_ns),
+        aqm_pmax_thr=jnp.asarray(aqm_pmax_thr),
         has_jitter=bool(exp.jitter_vv.max() > 0),
         has_stop=bool(exp.stop_time.min() < NO_STOP),
         has_cpu=bool(exp.cpu_ns_per_event.max() > 0),
         has_qlen=bool((exp.tx_qlen_bytes.max() > 0) or (exp.rx_qlen_bytes.max() > 0)),
+        has_aqm=bool(np.asarray(exp.aqm_max_bytes).max() > 0),
     )
 
 

@@ -78,6 +78,7 @@ class CpuEngine:
             "down_pkts": 0,
             "nic_tx_drops": 0,
             "nic_rx_drops": 0,
+            "nic_aqm_drops": 0,
         }
         self.model = self._make_model()
         self.model.start()

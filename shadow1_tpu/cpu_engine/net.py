@@ -33,6 +33,7 @@ from shadow1_tpu.consts import (
     N_MSG,
     N_PEER_FIN,
     N_SPACE,
+    R_AQM,
     TCP_CLOSE_WAIT,
     TCP_CLOSING,
     TCP_ESTABLISHED,
@@ -128,7 +129,7 @@ class CpuNetModel:
         self.tx_bytes = np.zeros(h, np.int64)
         self.rx_bytes = np.zeros(h, np.int64)
         # Finite NIC queues (router.c drop-tail; mirror of net/nic.py).
-        from shadow1_tpu.core.engine import qlen_ns_np
+        from shadow1_tpu.core.engine import aqm_tables_np, qlen_ns_np
 
         self.tx_qlen_ns = qlen_ns_np(eng.exp.tx_qlen_bytes, eng.exp.bw_up)
         self.rx_qlen_ns = qlen_ns_np(eng.exp.rx_qlen_bytes, eng.exp.bw_dn)
@@ -136,6 +137,13 @@ class CpuNetModel:
             (np.asarray(eng.exp.tx_qlen_bytes).max() > 0)
             or (np.asarray(eng.exp.rx_qlen_bytes).max() > 0)
         )
+        # RED AQM on the uplink (mirror of net/nic.py tx_stamp — identical
+        # integer thresholds from the one shared table builder).
+        self.aqm_min_ns, self.aqm_span_ns, self.aqm_pmax_thr = aqm_tables_np(
+            eng.exp
+        )
+        self.has_aqm = bool(np.asarray(eng.exp.aqm_max_bytes).max() > 0)
+        self.aqm_ctr = np.zeros(h, np.int64)
         self.socks = [
             [CpuSock() for _ in range(self.pr.sockets_per_host)] for _ in range(h)
         ]
@@ -168,7 +176,23 @@ class CpuNetModel:
     # NIC + packet emission (mirror of tcp.py _emit / net.udp_send)
     # ------------------------------------------------------------------
     def _tx(self, host: int, wire: int, now: int) -> int | None:
-        """Reserve the uplink; None = drop-tail (queue bound exceeded)."""
+        """Reserve the uplink; None = dropped (RED early-drop, then
+        drop-tail on the queue bound — the order tx_stamp uses)."""
+        if self.has_aqm:
+            ctr = int(self.aqm_ctr[host])
+            self.aqm_ctr[host] += 1
+            pmax_thr = int(self.aqm_pmax_thr[host])
+            if pmax_thr > 0:
+                backlog = max(int(self.tx_free[host]) - now, 0)
+                span = int(self.aqm_span_ns[host])
+                delta = min(max(backlog - int(self.aqm_min_ns[host]), 0), span)
+                if delta >= span:
+                    thr = 1 << 32  # ≥ max threshold: certain drop
+                else:
+                    thr = (pmax_thr * ((delta << 16) // span)) >> 16
+                if int(self.eng.draws.bits(R_AQM, host, ctr)) < thr:
+                    self.eng.metrics["nic_aqm_drops"] += 1
+                    return None
         if self.has_qlen and (int(self.tx_free[host]) - now) > int(self.tx_qlen_ns[host]):
             self.eng.metrics["nic_tx_drops"] += 1
             return None

@@ -30,7 +30,7 @@ from shadow1_tpu.consts import (
 )
 from shadow1_tpu.core.events import push_local
 from shadow1_tpu.core.outbox import outbox_append
-from shadow1_tpu.net.nic import NicState, nic_init, rx_stamp, tx_stamp
+from shadow1_tpu.net.nic import NicState, ctx_aqm, nic_init, rx_stamp, tx_stamp
 from shadow1_tpu.tcp import tcp as T
 
 
@@ -86,9 +86,10 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     p = p.at[:, 7].set(jnp.asarray(meta, jnp.int32))
     p = p.at[:, 8].set(jnp.asarray(meta2, jnp.int32))
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
-    nic, depart, sent = tx_stamp(
+    nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
         ctx.tx_qlen_ns if ctx.has_qlen else None,
+        aqm=ctx_aqm(ctx),
     )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
     outbox, ok = outbox_append(st.outbox, sent, dst_host, k, depart, p)
@@ -98,7 +99,9 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
         outbox=outbox,
         metrics=m._replace(
             ob_overflow=m.ob_overflow + (sent & ~ok).sum(dtype=jnp.int64),
-            nic_tx_drops=m.nic_tx_drops + (mask & ~sent).sum(dtype=jnp.int64),
+            nic_tx_drops=m.nic_tx_drops
+            + (mask & ~sent & ~red).sum(dtype=jnp.int64),
+            nic_aqm_drops=m.nic_aqm_drops + red.sum(dtype=jnp.int64),
         ),
     )
 

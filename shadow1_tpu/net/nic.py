@@ -14,8 +14,10 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
-from shadow1_tpu.consts import SEC
+from shadow1_tpu import rng
+from shadow1_tpu.consts import R_AQM, SEC
 
 
 class NicState(NamedTuple):
@@ -23,11 +25,23 @@ class NicState(NamedTuple):
     rx_free: jnp.ndarray   # i64 [H] downlink busy until
     tx_bytes: jnp.ndarray  # i64 [H]
     rx_bytes: jnp.ndarray  # i64 [H]
+    aqm_ctr: jnp.ndarray   # i64 [H] uplink enqueue-attempt counter (RED coin)
 
 
 def nic_init(n_hosts: int) -> NicState:
     z = lambda: jnp.zeros(n_hosts, jnp.int64)
-    return NicState(z(), z(), z(), z())
+    return NicState(z(), z(), z(), z(), z())
+
+
+def ctx_aqm(ctx):
+    """The ``aqm`` argument for tx_stamp from an engine Ctx (None = off)."""
+    if not ctx.has_aqm:
+        return None
+    return (ctx.key, ctx.hosts, ctx.aqm_min_ns, ctx.aqm_span_ns,
+            ctx.aqm_pmax_thr)
+
+
+_RED_CERTAIN = np.uint64(1) << np.uint64(32)  # threshold meaning "always"
 
 
 def ser_delay(wire_bytes, bw_bits):
@@ -36,13 +50,39 @@ def ser_delay(wire_bytes, bw_bits):
     return (w * (8 * SEC) + bw_bits - 1) // bw_bits
 
 
-def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up, qlen_ns=None):
-    """Reserve the uplink: returns (nic', depart_time[H], ok[H]).
+def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up, qlen_ns=None,
+             aqm=None):
+    """Reserve the uplink: returns (nic', depart_time[H], ok[H], red[H]).
 
-    With a finite queue (``qlen_ns``, the bound expressed as serialization
-    backlog time — src/main/routing/router.c's upstream drop-tail queue),
-    a packet is DROPPED (ok=False, link not reserved) when the backlog
-    already exceeds the bound."""
+    Two drop gates, in order (both off by default):
+
+    * **RED early drop** (``aqm`` from ctx_aqm — router.c's upstream AQM):
+      with instantaneous backlog q, drop probability ramps linearly 0→pmax
+      over [min, min+span) and is 1 at ≥ min+span. The coin is the shared
+      counter RNG at (R_AQM, host, per-host attempt counter) — the counter
+      advances on EVERY masked attempt (enabled or not, dropped or not), so
+      both engines see identical streams. Integer pipeline: Q16 backlog
+      ratio × the u64 pmax threshold, compared against the raw 32 coin bits.
+    * **drop-tail** (``qlen_ns``, the bound expressed as serialization
+      backlog time — router.c's queue bound): a packet is DROPPED (ok=False,
+      link not reserved) when the backlog already exceeds the bound.
+    """
+    red = jnp.zeros_like(mask)
+    if aqm is not None:
+        key, hosts, min_ns, span_ns, pmax_thr = aqm
+        coin = rng.bits(key, R_AQM, hosts, nic.aqm_ctr)
+        nic = nic._replace(aqm_ctr=nic.aqm_ctr + mask.astype(jnp.int64))
+        backlog = jnp.maximum(nic.tx_free - jnp.asarray(now, jnp.int64), 0)
+        delta = jnp.clip(backlog - min_ns, 0, span_ns)
+        ratio_q16 = (
+            (delta.astype(jnp.uint64) << np.uint64(16))
+            // span_ns.astype(jnp.uint64)
+        )
+        thr = (pmax_thr * ratio_q16) >> np.uint64(16)
+        thr = jnp.where(delta >= span_ns, _RED_CERTAIN, thr)
+        thr = jnp.where(pmax_thr > np.uint64(0), thr, np.uint64(0))
+        red = mask & rng.uniform_lt(coin, thr)
+        mask = mask & ~red
     if qlen_ns is not None:
         mask = mask & ((nic.tx_free - jnp.asarray(now, jnp.int64)) <= qlen_ns)
     depart = jnp.maximum(now, nic.tx_free)
@@ -55,6 +95,7 @@ def tx_stamp(nic: NicState, mask, wire_bytes, now, bw_up, qlen_ns=None):
         ),
         depart,
         mask,
+        red,
     )
 
 

@@ -50,6 +50,10 @@ class Heartbeat:
             "events_per_sec": round(delta["events"] / dt, 1) if dt > 0 else None,
             "sim_per_wall": round((self.engine.window * delta["windows"] / SEC) / dt, 4)
             if dt > 0 else None,
+            # Occupancy: how many handler rounds the busiest host forced per
+            # window this chunk (the per-window fixed-cost multiplier).
+            "rounds_per_window": round(delta["rounds"] / delta["windows"], 2)
+            if delta.get("windows") else None,
             "delta": delta,
         }
         self.records.append(rec)

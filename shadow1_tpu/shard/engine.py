@@ -150,10 +150,13 @@ class ShardedEngine:
             hosts=gctx.hosts, bw_up=gctx.bw_up, bw_dn=gctx.bw_dn,
             stop_time=gctx.stop_time, cpu_cost=gctx.cpu_cost,
             tx_qlen_ns=gctx.tx_qlen_ns, rx_qlen_ns=gctx.rx_qlen_ns,
+            aqm_min_ns=gctx.aqm_min_ns, aqm_span_ns=gctx.aqm_span_ns,
+            aqm_pmax_thr=gctx.aqm_pmax_thr,
         )
         flags = dict(
             has_jitter=gctx.has_jitter, has_stop=gctx.has_stop,
             has_cpu=gctx.has_cpu, has_qlen=gctx.has_qlen,
+            has_aqm=gctx.has_aqm,
         )
         jitter_vv = gctx.jitter_vv
 
@@ -182,6 +185,9 @@ class ShardedEngine:
                 cpu_cost=cols["cpu_cost"],
                 tx_qlen_ns=cols["tx_qlen_ns"],
                 rx_qlen_ns=cols["rx_qlen_ns"],
+                aqm_min_ns=cols["aqm_min_ns"],
+                aqm_span_ns=cols["aqm_span_ns"],
+                aqm_pmax_thr=cols["aqm_pmax_thr"],
                 **flags,
             )
             handlers = model.make_handlers(ctx)

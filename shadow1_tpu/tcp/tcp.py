@@ -66,7 +66,7 @@ from shadow1_tpu.consts import (  # noqa: F811 — shared tuning/state sets
 )
 from shadow1_tpu.core.dense import get_col, onehot_col, set_col
 from shadow1_tpu.core.outbox import outbox_append, outbox_space
-from shadow1_tpu.net.nic import tx_stamp
+from shadow1_tpu.net.nic import ctx_aqm, tx_stamp
 
 # Fields of the TCP state dict, all [H, S] unless noted.
 _FIELDS_I32 = (
@@ -168,19 +168,22 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
     p = p.at[:, 6].set(mend)
     p = p.at[:, 7].set(mmeta)
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
-    nic, depart, sent = tx_stamp(
+    nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
         ctx.tx_qlen_ns if ctx.has_qlen else None,
+        aqm=ctx_aqm(ctx),
     )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
-    # A queue-dropped segment behaves exactly like path loss: sequence state
-    # advanced, packet never routed — retransmission recovers it.
+    # A queue-dropped segment (tail or RED) behaves exactly like path loss:
+    # sequence state advanced, packet never routed — retransmission recovers.
     outbox, ok = outbox_append(st.outbox, sent, r.g("peer_host"), k, depart, p)
     m = st.metrics
     return st._replace(
         model=st.model._replace(nic=nic), outbox=outbox,
         metrics=m._replace(
-            nic_tx_drops=m.nic_tx_drops + (mask & ~sent).sum(dtype=jnp.int64),
+            nic_tx_drops=m.nic_tx_drops
+            + (mask & ~sent & ~red).sum(dtype=jnp.int64),
+            nic_aqm_drops=m.nic_aqm_drops + red.sum(dtype=jnp.int64),
             # tcp_flush checks outbox_space before every segment, so this
             # "cannot" fire — but a vanishing segment with no counter would
             # be the worst possible failure mode, and the oracle counts it.

@@ -47,7 +47,9 @@ class PcapWriter:
         ss, ds, flags = packed & 0xFF, (packed >> 8) & 0xFF, (packed >> 16) & 0xFF
         length = int(p[4])
         if flags & F_DGRAM:
-            l4 = struct.pack(">HHHH", 10000 + ss, 10000 + ds, 8 + length, 0)
+            l4 = struct.pack(
+                ">HHHH", 10000 + ss, 10000 + ds, min(8 + length, 0xFFFF), 0
+            )
             proto = 17
         else:
             tcp_flags = (
@@ -67,11 +69,14 @@ class PcapWriter:
             ">BBHHHBBH", 0x45, 0, min(total, 0xFFFF), self.n_packets & 0xFFFF,
             0, 64, proto, 0,
         ) + _ip(src) + _ip(dst)
-        frame = ip + l4 + b"\x00" * length
-        incl = min(len(frame), self.snaplen)
+        # Pad only what the snaplen keeps; orig_len carries the true size.
+        head = ip + l4
+        orig = len(head) + length
+        incl = min(orig, self.snaplen)
+        frame = (head + b"\x00" * max(incl - len(head), 0))[:incl]
         ts_sec, rem = divmod(int(time_ns), 10**9)
-        self.f.write(struct.pack("<IIII", ts_sec, rem // 1000, incl, len(frame)))
-        self.f.write(frame[:incl])
+        self.f.write(struct.pack("<IIII", ts_sec, rem // 1000, incl, orig))
+        self.f.write(frame)
         self.n_packets += 1
 
     def close(self) -> None:

@@ -18,7 +18,7 @@ from shadow1_tpu.cpu_engine import CpuEngine
 KEYS = [
     "events", "pkts_sent", "pkts_delivered", "pkts_lost",
     "ev_overflow", "ob_overflow", "down_events", "down_pkts",
-    "nic_tx_drops", "nic_rx_drops",
+    "nic_tx_drops", "nic_rx_drops", "nic_aqm_drops",
 ]
 
 
@@ -100,3 +100,30 @@ def test_nic_queue_drops_parity():
     st = eng.run()
     s = eng.model_summary(st)
     assert int(s["total_flows_done"]) == 5  # all flows survive the drops
+
+
+def test_red_aqm_parity():
+    """RED early-drop on the uplink (router.c upstream AQM): with thresholds
+    well inside the congested server's backlog, probabilistic drops fire —
+    and the coin is the shared counter RNG, so both engines drop the exact
+    same packets. Flows still complete via retransmission."""
+    params = EngineParams(ev_cap=256)
+    m = _both(_red_exp(), params)
+    assert m["nic_aqm_drops"] > 0, m
+    # RED alone (no drop-tail): the tail counter must stay untouched.
+    assert m["nic_tx_drops"] == 0 and m["nic_rx_drops"] == 0
+
+    eng = Engine(_filexfer(), params)
+    base = Engine.metrics_dict(eng.run())
+    assert base["nic_aqm_drops"] == 0  # off by default
+    eng3 = Engine(_red_exp(), params)
+    s = eng3.model_summary(eng3.run())
+    assert int(s["total_flows_done"]) == 5  # flows survive RED drops
+
+
+def _red_exp(n=6):
+    exp = _filexfer(n)
+    exp.aqm_min_bytes = np.full(n, 2_000, np.int64)
+    exp.aqm_max_bytes = np.full(n, 12_000, np.int64)
+    exp.aqm_pmax = np.full(n, 0.3, np.float64)
+    return exp
