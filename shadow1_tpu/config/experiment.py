@@ -188,17 +188,32 @@ def _per_host_array(name, dtype, default, parser, groups, defaults, group_cfg, h
     arr = np.full(h, default, dtype)
     conv = parser or (lambda x: x)
     if name in defaults:
-        arr[:] = conv(defaults[name])
+        arr[:] = _group_values(name, defaults[name], conv, h, np.arange(h))
     for g in groups:
         block = group_cfg.get(g.name, {})
         if name in block:
             val = block[name]
-            if isinstance(val, list):
-                assert len(val) == g.count, (name, g.name)
-                arr[g.ids] = [conv(x) for x in val]
-            else:
-                arr[g.ids] = conv(val)
+            arr[g.ids] = _group_values(name, val, conv, g.count,
+                                       np.arange(g.count))
     return arr
+
+
+def _group_values(name, val, conv, count, idx):
+    """One app-param value spec → per-host values for a group of ``count``.
+
+    Three forms: a scalar (broadcast), a list (one per host), or a stagger
+    dict ``{start: X, interval: Y}`` → ``start + i·interval`` for host i in
+    the group — the idiom for spreading e.g. client bootstrap times so a
+    10k-client rung does not burst every dirauth in one window (the
+    reference's example configs stagger client start times the same way)."""
+    if isinstance(val, dict):
+        extra = set(val) - {"start", "interval"}
+        assert not extra, f"unknown stagger keys for {name}: {extra}"
+        return conv(val.get("start", 0)) + idx * conv(val.get("interval", 0))
+    if isinstance(val, list):
+        assert len(val) == count, (name, count)
+        return [conv(x) for x in val]
+    return conv(val)
 
 
 def _gen_bitcoin_cfg(model_cfg: dict, h: int, seed: int) -> None:

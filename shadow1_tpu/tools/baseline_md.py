@@ -1,0 +1,70 @@
+"""Render benchmark-ladder JSON rows into BASELINE.md's results table.
+
+    python -m shadow1_tpu.tools.baseline_md LADDER_r03.json [...more.json]
+
+Reads the row files produced by ``bench_ladder.py --json`` and prints a
+markdown table (newest measurement per rung wins). Paste-ready for
+BASELINE.md; keeping the renderer in-repo makes each round's refresh one
+command instead of hand-edited numbers (SURVEY §6a: the ladder is the
+measured baseline this repo produces for itself).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+
+def load_rows(paths: list[str]) -> dict[str, dict]:
+    rows: dict[str, dict] = {}
+    for p in paths:
+        with open(p) as f:
+            for row in json.load(f):
+                rows[row["rung"]] = row  # later files win
+    return rows
+
+
+def fmt(row: dict) -> str:
+    if "error" in row:
+        return (f"| {row['rung']} | — | — | — | — | — | — | — | "
+                f"FAILED: `{row['error'][:60]}` |")
+    win = f"{row['windows']}/{row['windows_configured']}"
+    if row.get("status") == "done":
+        win = str(row["windows"])
+    over = row["ev_overflow"] + row["ob_overflow"]
+    note = []
+    if row.get("status") == "budget":
+        note.append("budget-capped")
+    if row.get("process_respawns"):
+        note.append(f"{row['process_respawns']} fault-resumes")
+    if row.get("round_cap_hits"):
+        note.append(f"{row['round_cap_hits']} round-cap hits")
+    if row.get("oracle_events_per_sec"):
+        note.append(f"oracle {row['oracle_events_per_sec']:,.0f} ev/s"
+                    f" on {row['oracle_windows']} win")
+    return (
+        f"| {row['rung']} | {row['n_hosts']:,} | {win} "
+        f"| {row['events']:,} | **{row['events_per_sec']:,.0f}** "
+        f"| {row['sim_per_wall']:.3f} | {row['wall_s']:.0f} + "
+        f"{row['compile_s']:.0f}c | {over} | {'; '.join(note) or '—'} |"
+    )
+
+
+def main() -> None:
+    rows = load_rows(sys.argv[1:])
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], capture_output=True, text=True
+    ).stdout.strip() or "?"
+    print(f"Measured on the single axon TPU v5 lite chip, commit {commit}; "
+          f"walls in seconds, compile excluded ('+ Nc' column).")
+    print()
+    print("| rung | hosts | windows | events | events/s | sim/wall "
+          "| wall + compile | overflow | notes |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for name in sorted(rows):
+        print(fmt(rows[name]))
+
+
+if __name__ == "__main__":
+    main()

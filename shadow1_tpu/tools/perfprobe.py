@@ -1,0 +1,125 @@
+"""Per-round cost decomposition by shape ablation.
+
+    python -m shadow1_tpu.tools.perfprobe [probe ...]
+
+The axon tunnel reports zero-duration device ops in profiler traces, so
+op-level profiling is unavailable; instead this times warm window loops on
+synthetic workloads that isolate one cost axis each (SURVEY §7.1-style
+measurement; VERDICT r2 weak #4 asked for exactly this breakdown):
+
+* ``phold``      — pop/push/route/deliver fixed cost at [H, ev_cap] shapes,
+                   no transport (the floor every net round pays).
+* ``fx_s{8,64}`` — the TCP stack at sockets_per_host S: [H, S] state ops.
+* ``fx_mq{8,64}``— message-boundary FIFO capacity: [H, S, mq] state ops.
+
+Every probe reports ms/window, rounds/window and ms/round; comparing
+ms/round across probes attributes the per-round cost to the axis that
+changed. One JSON line per probe on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _pairs_filexfer(n_hosts: int, flow_bytes: int = 120_000):
+    """n/2 independent (server <- client) pairs: per-host socket load is
+    constant, so S / mq knobs change only tensor shapes, not behavior."""
+    from shadow1_tpu.config.compiled import single_vertex_experiment
+    from shadow1_tpu.consts import MS
+
+    n = n_hosts
+    role = (np.arange(n) % 2).astype(np.int64)        # even=server, odd=client
+    server = (np.arange(n) - 1).clip(0).astype(np.int64)
+    return single_vertex_experiment(
+        n_hosts=n, seed=77, end_time=10**12, latency_ns=30 * MS,
+        bw_bits=10**8, model="net",
+        model_cfg={
+            "app": "filexfer",
+            "role": role,
+            "server": server,
+            "flow_bytes": np.full(n, flow_bytes, np.int64),
+            "start_time": np.full(n, 1 * MS, np.int64),
+            # keep flows alive for the whole probe
+            "flow_count": np.where(role == 1, 1_000_000, 0),
+        },
+    )
+
+
+def _phold(n_hosts: int):
+    from shadow1_tpu.config.compiled import single_vertex_experiment
+    from shadow1_tpu.consts import MS
+
+    return single_vertex_experiment(
+        n_hosts=n_hosts, seed=77, end_time=10**12, latency_ns=30 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(60 * MS), "init_events": 4},
+    )
+
+
+def time_engine(exp, params, warm=20, measure=40) -> dict:
+    import jax
+
+    from shadow1_tpu.core.engine import Engine
+
+    eng = Engine(exp, params)
+    jax.block_until_ready(eng.run(eng.init_state(), n_windows=0))  # compile
+    st = eng.run(eng.init_state(), n_windows=warm)
+    jax.block_until_ready(st)
+    m0 = Engine.metrics_dict(st)
+    t0 = time.perf_counter()
+    st = eng.run(st, n_windows=measure)
+    jax.block_until_ready(st)
+    wall = time.perf_counter() - t0
+    m1 = Engine.metrics_dict(st)
+    rounds = m1["rounds"] - m0["rounds"]
+    events = m1["events"] - m0["events"]
+    return {
+        "ms_per_window": round(1000 * wall / measure, 2),
+        "rounds_per_window": round(rounds / measure, 2),
+        "ms_per_round": round(1000 * wall / max(rounds, 1), 3),
+        "events_per_sec": round(events / wall, 1),
+        "ev_overflow": m1["ev_overflow"],
+        "ob_overflow": m1["ob_overflow"],
+    }
+
+
+def probes(n_hosts: int):
+    from shadow1_tpu.consts import EngineParams
+
+    yield "phold", _phold(n_hosts), EngineParams(ev_cap=256)
+    for s in (8, 64):
+        yield (f"fx_s{s}", _pairs_filexfer(n_hosts),
+               EngineParams(ev_cap=256, sockets_per_host=s, msgq_cap=8))
+    for mq in (8, 64):
+        yield (f"fx_mq{mq}", _pairs_filexfer(n_hosts),
+               EngineParams(ev_cap=256, sockets_per_host=64, msgq_cap=mq))
+
+
+def main() -> None:
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+    import jax
+
+    n_hosts = 1000
+    only = set(sys.argv[1:])
+    for name, exp, params in probes(n_hosts):
+        if only and name not in only:
+            continue
+        try:
+            r = time_engine(exp, params)
+        except Exception as e:  # noqa: BLE001
+            r = {"error": repr(e)[:300]}
+        row = {"probe": name, "n_hosts": n_hosts,
+               "backend": jax.default_backend(), **r}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -91,3 +91,18 @@ def test_cli_runs_rung1(capsys):
     out = json.loads(capsys.readouterr().out)
     assert out["engine"] == "cpu"
     assert out["metrics"]["events"] > 0
+
+
+def test_stagger_start_times():
+    """Group param dict form {start, interval}: host i of the group gets
+    start + i*interval (the rung-4 client-bootstrap stagger)."""
+    from shadow1_tpu.consts import MS
+
+    exp, _, _ = load_experiment(os.path.join(CONFIGS, "rung4_tor10k.yaml"))
+    st = exp.model_cfg["start_time"]
+    clients = np.where(exp.model_cfg["role"] == 1)[0]
+    assert st[clients[0]] == 200 * MS
+    assert st[clients[1]] - st[clients[0]] == 2 * MS
+    assert st[clients[-1]] == 200 * MS + (len(clients) - 1) * 2 * MS
+    relays = np.where(exp.model_cfg["role"] == 0)[0]
+    assert (st[relays] == 200 * MS).all()  # non-staggered groups untouched

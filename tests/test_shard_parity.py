@@ -18,6 +18,7 @@ from shadow1_tpu.shard.engine import ShardedEngine
 SEMANTIC_KEYS = [
     "events", "windows", "pkts_sent", "pkts_delivered", "pkts_lost",
     "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+    "nic_tx_drops", "nic_rx_drops", "nic_aqm_drops",
     "x2x_overflow",  # all_to_all bucket drops: must be 0 (== single-device)
 ]
 
@@ -120,3 +121,34 @@ def test_filexfer_sharded_parity():
     m1, s1, m8, s8 = run_pair(exp, EngineParams(ev_cap=256))
     assert int(s1["total_flows_done"]) == 7
     assert_same(m1, s1, m8, s8, summary_keys=("rx_bytes", "flows_done", "done_time"))
+
+
+def test_filexfer_red_aqm_sharded_parity():
+    """RED AQM under sharding: the per-host aqm columns (thresholds, coin
+    counters) ride the mesh like every other [H] tensor; drops must land on
+    the exact same packets as the single-device engine."""
+    n = 8
+    role = np.full(n, 1, np.int64)
+    role[0] = 0
+    exp = single_vertex_experiment(
+        n_hosts=n,
+        seed=3,
+        end_time=20 * SEC,
+        latency_ns=10 * MS,
+        bw_bits=10**6,
+        model="net",
+        model_cfg={
+            "app": "filexfer",
+            "role": role,
+            "server": np.zeros(n, np.int64),
+            "flow_bytes": np.full(n, 60_000, np.int64),
+            "start_time": np.full(n, 1 * MS, np.int64),
+            "flow_count": np.where(role == 1, 1, 0),
+        },
+        aqm_min_bytes=np.full(n, 2_000, np.int64),
+        aqm_max_bytes=np.full(n, 12_000, np.int64),
+        aqm_pmax=np.full(n, 0.3, np.float64),
+    )
+    m1, s1, m8, s8 = run_pair(exp, EngineParams(ev_cap=256))
+    assert m1["nic_aqm_drops"] > 0  # RED actually fired
+    assert_same(m1, s1, m8, s8, summary_keys=("rx_bytes", "flows_done"))
