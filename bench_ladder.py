@@ -206,7 +206,12 @@ def run_rung(name: str, path: str, windows_override: int | None,
         respawns += 1
         print(f"[{name}] device fault at {rec['done']}/{rec['total']} "
               f"windows — respawning ({respawns})", file=sys.stderr, flush=True)
-    if os.path.exists(state_path):
+    if rec["status"] == "fault":
+        # Terminal fault: keep the checkpoint — it is the only resumable
+        # artifact, and a rerun against a recovered device continues from it.
+        print(f"[{name}] giving up; resumable checkpoint kept at "
+              f"{state_path}", file=sys.stderr, flush=True)
+    elif os.path.exists(state_path):
         os.remove(state_path)
 
     from shadow1_tpu.config.experiment import load_experiment
@@ -219,6 +224,7 @@ def run_rung(name: str, path: str, windows_override: int | None,
     row = {
         "rung": name,
         "config": path,
+        "commit": _git_head(),
         "status": rec["status"],
         "n_hosts": exp.n_hosts,
         "windows": done,
@@ -244,6 +250,15 @@ def run_rung(name: str, path: str, windows_override: int | None,
         if k in rec["summary"]:
             row[k] = rec["summary"][k]
     return row
+
+
+def _git_head() -> str:
+    """Commit the measurement ran at — recorded in each row so renders never
+    misattribute numbers to a later HEAD."""
+    r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                       capture_output=True, text=True,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+    return r.stdout.strip() or "?"
 
 
 def run_oracle_slice(name: str, path: str, tpu_row: dict) -> dict:
@@ -322,18 +337,22 @@ def main() -> None:
                    "traceback": traceback.format_exc()[-1500:]}
         rows.append(row)
         print(json.dumps(row), flush=True)
-        ok = "error" not in row
-        print(
-            f"[{name}] " + (
-                f"{row['events_per_sec']:>12,.0f} ev/s  sim/wall "
-                f"{row['sim_per_wall']:.3f}  wall {row['wall_s']}s  "
+        if "error" in row:
+            line = f"FAILED: {row['error']}"
+        else:
+            eps = row["events_per_sec"]
+            spw = row["sim_per_wall"]
+            line = (
+                f"{eps:>12,.0f} ev/s  " if eps is not None else "  (no wall)  "
+            ) + (
+                f"sim/wall {spw:.3f}  " if spw is not None else ""
+            ) + (
+                f"wall {row['wall_s']}s  "
                 f"windows {row['windows']}/{row['windows_configured']}  "
                 f"overflow {row['ev_overflow']}+{row['ob_overflow']}  "
-                f"respawns {row['process_respawns']}"
-                if ok else f"FAILED: {row['error']}"
-            ),
-            file=sys.stderr, flush=True,
-        )
+                f"respawns {row['process_respawns']}  status {row['status']}"
+            )
+        print(f"[{name}] {line}", file=sys.stderr, flush=True)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(rows, f, indent=1)

@@ -21,10 +21,19 @@ def _flatten(st):
 
 
 def save_state(st, path: str) -> None:
-    """Snapshot a SimState pytree to ``path`` (.npz)."""
+    """Snapshot a SimState pytree to ``path`` (.npz).
+
+    Write-then-rename: the fault-tolerant runners save while the device may
+    be about to wedge the process; a crash mid-write must leave the previous
+    snapshot intact, never a truncated zip."""
+    import os
+
     leaves, _ = _flatten(st)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    np.savez_compressed(path, **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)
 
 
 def load_state(template, path: str):

@@ -118,7 +118,8 @@ class Ctx:
     has_jitter: bool = False
     has_stop: bool = False
     has_cpu: bool = False
-    has_qlen: bool = False
+    has_tx_qlen: bool = False
+    has_rx_qlen: bool = False
     has_aqm: bool = False
 
     def __post_init__(self):
@@ -331,14 +332,21 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     )
 
 
-def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None) -> SimState:
+def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
+                pre_window=None) -> SimState:
     """One conservative window: inner rounds to quiescence, then delivery.
 
     The batched form of the reference's barrier round
     (scheduler_continueNextRound in src/main/core/scheduler/scheduler.c):
     the while_loop plays the worker event loop, the delivery plays the
-    cross-thread event push that the barrier makes safe."""
+    cross-thread event push that the barrier makes safe.
+
+    ``pre_window(st, ctx, win_end)`` is an optional model hook that runs
+    before the rounds — the net model uses it to batch-process every NIC
+    arrival of the window in one scan instead of one round per packet."""
     win_end = st.win_start + ctx.window
+    if pre_window is not None:
+        st = pre_window(st, ctx, win_end)
     max_rounds = ctx.params.max_rounds
 
     def cond(carry):
@@ -417,7 +425,8 @@ def fidelity_ctx_kwargs(exp) -> dict:
         has_jitter=bool(exp.jitter_vv.max() > 0),
         has_stop=bool(exp.stop_time.min() < NO_STOP),
         has_cpu=bool(exp.cpu_ns_per_event.max() > 0),
-        has_qlen=bool((exp.tx_qlen_bytes.max() > 0) or (exp.rx_qlen_bytes.max() > 0)),
+        has_tx_qlen=bool(exp.tx_qlen_bytes.max() > 0),
+        has_rx_qlen=bool(exp.rx_qlen_bytes.max() > 0),
         has_aqm=bool(np.asarray(exp.aqm_max_bytes).max() > 0),
     )
 
@@ -463,6 +472,9 @@ class Engine:
         )
         self._model = _model_module(exp.model)
         self._handlers = self._model.make_handlers(self.ctx)
+        self._pre_window = getattr(self._model, "make_pre_window", lambda c: None)(
+            self.ctx
+        )
         # No donation: the initial state contains aliased zero-buffers (XLA
         # rejects donating one buffer twice) and run() is called once per sim,
         # so the single input copy is negligible. n_windows is a TRACED
@@ -487,7 +499,8 @@ class Engine:
 
     # -- window step pieces ----------------------------------------------
     def _window_step(self, st: SimState) -> SimState:
-        return window_step(st, self.ctx, self._handlers)
+        return window_step(st, self.ctx, self._handlers,
+                           pre_window=self._pre_window)
 
     def _make_run(self):
         def run(st: SimState, n_windows) -> SimState:

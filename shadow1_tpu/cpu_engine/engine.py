@@ -22,6 +22,7 @@ import numpy as np
 from shadow1_tpu.config.compiled import CompiledExperiment
 from shadow1_tpu.consts import (
     K_PHOLD,
+    K_PKT,
     R_JITTER,
     R_LOSS,
     R_PHOLD_DELAY,
@@ -146,6 +147,14 @@ class CpuEngine:
             self.capture(arrival, src, dst, p, False)
         return True
 
+    def schedule_packet(self, host: int, time: int, tb: int, kind: int,
+                        p: tuple) -> None:
+        """Push with a caller-supplied tie-break (the packet's own): used by
+        the NIC rx fast path, which converts a just-popped K_PKT slot in
+        place — capacity cannot overflow (the pop freed a slot)."""
+        assert self.pending[host] < self.params.ev_cap
+        self._push(time, tb, host, kind, p)
+
     def _push(self, time: int, tb: int, host: int, kind: int, p: tuple) -> None:
         self.pending[host] += 1
         heapq.heappush(self.heap, (time, tb, self._gseq, host, kind, p))
@@ -160,6 +169,12 @@ class CpuEngine:
             # churn: a stopped host discards its events (core run_round rule)
             if self.has_stop and time >= self.stop_time[host]:
                 self.metrics["down_events"] += 1
+                continue
+            # NIC arrival fast path: rx processing is plumbing, not an event
+            # — no event count, no virtual-CPU charge (mirror of the batched
+            # engine's window-start conversion, net.make_pre_window).
+            if kind == K_PKT and getattr(self.model, "rx_batch", False):
+                self.model.rx_convert(host, time, tb, p)
                 continue
             # virtual CPU (host/cpu.c): execute at eff = max(time, busy); an
             # execution slipping past the window boundary re-queues at

@@ -133,10 +133,12 @@ class CpuNetModel:
 
         self.tx_qlen_ns = qlen_ns_np(eng.exp.tx_qlen_bytes, eng.exp.bw_up)
         self.rx_qlen_ns = qlen_ns_np(eng.exp.rx_qlen_bytes, eng.exp.bw_dn)
-        self.has_qlen = bool(
-            (np.asarray(eng.exp.tx_qlen_bytes).max() > 0)
-            or (np.asarray(eng.exp.rx_qlen_bytes).max() > 0)
-        )
+        self.has_tx_qlen = bool(np.asarray(eng.exp.tx_qlen_bytes).max() > 0)
+        self.has_rx_qlen = bool(np.asarray(eng.exp.rx_qlen_bytes).max() > 0)
+        # Without an rx queue bound, NIC arrival processing is plumbing, not
+        # an event: the engine run loop short-circuits K_PKT to rx_convert
+        # (mirror of net.make_pre_window's batched conversion).
+        self.rx_batch = not self.has_rx_qlen
         # RED AQM on the uplink (mirror of net/nic.py tx_stamp — identical
         # integer thresholds from the one shared table builder).
         self.aqm_min_ns, self.aqm_span_ns, self.aqm_pmax_thr = aqm_tables_np(
@@ -175,6 +177,16 @@ class CpuNetModel:
     # ------------------------------------------------------------------
     # NIC + packet emission (mirror of tcp.py _emit / net.udp_send)
     # ------------------------------------------------------------------
+    def rx_convert(self, host: int, time: int, tb: int, p: tuple) -> None:
+        """NIC arrival (rx_batch path): reserve the downlink FIFO and push
+        the deliver event with the PACKET's tie-break — bit-identical to the
+        batched engine's window-start conversion (net.make_pre_window)."""
+        wire = p[4] + WIRE_OVERHEAD
+        ready = max(time, int(self.rx_free[host]))
+        self.rx_free[host] = ready + ser_delay_ns(wire, int(self.eng.exp.bw_dn[host]))
+        self.rx_bytes[host] += wire
+        self.eng.schedule_packet(host, ready, tb, K_PKT_DELIVER, p)
+
     def _tx(self, host: int, wire: int, now: int) -> int | None:
         """Reserve the uplink; None = dropped (RED early-drop, then
         drop-tail on the queue bound — the order tx_stamp uses)."""
@@ -193,7 +205,7 @@ class CpuNetModel:
                 if int(self.eng.draws.bits(R_AQM, host, ctr)) < thr:
                     self.eng.metrics["nic_aqm_drops"] += 1
                     return None
-        if self.has_qlen and (int(self.tx_free[host]) - now) > int(self.tx_qlen_ns[host]):
+        if self.has_tx_qlen and (int(self.tx_free[host]) - now) > int(self.tx_qlen_ns[host]):
             self.eng.metrics["nic_tx_drops"] += 1
             return None
         depart = max(now, int(self.tx_free[host]))
@@ -335,8 +347,10 @@ class CpuNetModel:
     # ------------------------------------------------------------------
     def handle(self, host, time, kind, p):
         if kind == K_PKT:
+            # Only the rx-drop-tail path reaches here (rx_batch otherwise
+            # short-circuits in CpuEngine.run before event accounting).
             wire = p[4] + WIRE_OVERHEAD
-            if self.has_qlen and (int(self.rx_free[host]) - time) > int(self.rx_qlen_ns[host]):
+            if self.has_rx_qlen and (int(self.rx_free[host]) - time) > int(self.rx_qlen_ns[host]):
                 self.eng.metrics["nic_rx_drops"] += 1  # downlink drop-tail
                 return
             ready = max(time, int(self.rx_free[host]))

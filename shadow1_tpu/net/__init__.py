@@ -15,20 +15,23 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import (
     F_DGRAM,
     K_APP,
+    K_NONE,
     K_PKT,
     K_PKT_DELIVER,
     K_TCP_TIMER,
     K_TX_RESUME,
     N_DGRAM,
     NP,
+    SEC,
     WIRE_OVERHEAD,
 )
-from shadow1_tpu.core.events import push_local
+from shadow1_tpu.core.events import I64_MAX, push_local
 from shadow1_tpu.core.outbox import outbox_append
 from shadow1_tpu.net.nic import NicState, ctx_aqm, nic_init, rx_stamp, tx_stamp
 from shadow1_tpu.tcp import tcp as T
@@ -88,7 +91,7 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
-        ctx.tx_qlen_ns if ctx.has_qlen else None,
+        ctx.tx_qlen_ns if ctx.has_tx_qlen else None,
         aqm=ctx_aqm(ctx),
     )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
@@ -106,6 +109,88 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     )
 
 
+def make_pre_window(ctx):
+    """Batched NIC-arrival processing — the K_PKT round eliminator.
+
+    Packet arrivals dominated the inner-round count: every delivered packet
+    cost its host one K_PKT round (NIC receive-queue stamp) before its
+    K_PKT_DELIVER round, and a busy relay's round count is the per-window
+    maximum. But the NIC rx chain depends ONLY on arrival order and the
+    rx_free clock — never on interleaved app/timer events — and every
+    K_PKT eligible in a window exists in the event buffer at window start
+    (packets are created only by the window-end exchange). So one batched
+    per-host pass computes the exact FIFO schedule the per-round handler
+    would: sort each host's eligible K_PKT slots by (time, tb), run a
+    max-plus associative scan ``free_j = max(free_{j-1}, arr_j) + ser_j``,
+    and convert each slot IN PLACE to K_PKT_DELIVER at its queue-cleared
+    time, keeping the packet's own tie-break (docs/SEMANTICS.md §packet
+    path — the oracle mirrors this exactly, so parity is bit-identical).
+
+    Returns None (keeping the per-round K_PKT handler) when the rx
+    drop-tail queue is configured: its drop decisions feed back into the
+    clock recurrence, which breaks the max-plus associativity."""
+    if ctx.has_rx_qlen:
+        return None
+    neg = -(1 << 62)
+
+    def pre_window(st, _ctx, win_end):
+        buf = st.evbuf
+        h, cap = buf.time.shape
+        sel = (buf.kind == K_PKT) & (buf.time < win_end)
+        kind0, time0 = buf.kind, buf.time
+        m = st.metrics
+        if ctx.has_stop:
+            # A stopped host discards arrivals unprocessed (run_round rule);
+            # they must not reserve the downlink.
+            down = sel & (buf.time >= ctx.stop_time[:, None])
+            sel = sel & ~down
+            kind0 = jnp.where(down, K_NONE, kind0)
+            time0 = jnp.where(down, I64_MAX, time0)
+            m = m._replace(down_events=m.down_events
+                           + down.sum(dtype=jnp.int64))
+        t_key = jnp.where(sel, buf.time, I64_MAX)
+        tb_key = jnp.where(sel, buf.tb, I64_MAX)
+        idx = jnp.broadcast_to(
+            jnp.arange(cap, dtype=jnp.int32)[None, :], (h, cap)
+        )
+        t_s, _tb_s, idx_s = jax.lax.sort(
+            (t_key, tb_key, idx), dimension=-1, num_keys=2
+        )
+        valid = t_s < I64_MAX
+        plen = jnp.take_along_axis(buf.p[:, :, 4], idx_s, axis=1)
+        wire = jnp.where(valid, plen.astype(jnp.int64) + WIRE_OVERHEAD, 0)
+        bw = ctx.bw_dn[:, None]
+        ser = jnp.where(valid, (wire * (8 * SEC) + bw - 1) // bw, 0)
+        # Max-plus prefix: each packet is the affine map x ↦ max(x+p, q)
+        # with p = ser, q = arr + ser; invalid slots are the identity.
+        pq = (ser, jnp.where(valid, t_s + ser, neg))
+        p_pre, q_pre = jax.lax.associative_scan(
+            lambda a, b: (a[0] + b[0], jnp.maximum(a[1] + b[0], b[1])),
+            pq, axis=1,
+        )
+        free0 = st.model.nic.rx_free[:, None]
+        free = jnp.maximum(free0 + p_pre, q_pre)      # clock after packet j
+        ready = free - ser                            # = max(free_{j-1}, arr)
+        # Un-sort: order by slot index restores original positions.
+        _i, ready_o, valid_o = jax.lax.sort(
+            (idx_s, ready, valid.astype(jnp.int32)), dimension=-1, num_keys=1
+        )
+        vo = valid_o != 0
+        nic = st.model.nic._replace(
+            rx_free=free[:, -1],
+            rx_bytes=st.model.nic.rx_bytes + wire.sum(axis=1),
+        )
+        evbuf = buf._replace(
+            kind=jnp.where(vo, K_PKT_DELIVER, kind0),
+            time=jnp.where(vo, ready_o, time0),
+        )
+        return st._replace(
+            evbuf=evbuf, model=st.model._replace(nic=nic), metrics=m
+        )
+
+    return pre_window
+
+
 def make_handlers(ctx):
     app_mod = _app_module(ctx.model_cfg["app"])
     app_on_notify = app_mod.on_notify
@@ -118,7 +203,7 @@ def make_handlers(ctx):
         wire = jnp.asarray(ev.p[:, 4], jnp.int64) + WIRE_OVERHEAD
         nic, ready, okq = rx_stamp(
             st.model.nic, m, wire, ev.time, ctx.bw_dn,
-            ctx.rx_qlen_ns if ctx.has_qlen else None,
+            ctx.rx_qlen_ns if ctx.has_rx_qlen else None,
         )
         st = st._replace(model=st.model._replace(nic=nic))
         k = jnp.full(ctx.n_hosts, K_PKT_DELIVER, jnp.int32)
@@ -155,13 +240,18 @@ def make_handlers(ctx):
         m = ev.mask & (ev.kind == K_APP)
         return app_on_wakeup(st, ctx, ev, m)
 
-    return {
+    handlers = {
         K_PKT: on_pkt,
         K_PKT_DELIVER: on_deliver,
         K_TCP_TIMER: on_timer,
         K_TX_RESUME: on_txr,
         K_APP: on_app,
     }
+    if not ctx.has_rx_qlen:
+        # Arrivals are batch-converted by make_pre_window — no K_PKT event
+        # ever reaches a round, so the pass (and its cond) would be dead.
+        del handlers[K_PKT]
+    return handlers
 
 
 def summary(model: NetState, ctx) -> dict:

@@ -155,8 +155,8 @@ class ShardedEngine:
         )
         flags = dict(
             has_jitter=gctx.has_jitter, has_stop=gctx.has_stop,
-            has_cpu=gctx.has_cpu, has_qlen=gctx.has_qlen,
-            has_aqm=gctx.has_aqm,
+            has_cpu=gctx.has_cpu, has_tx_qlen=gctx.has_tx_qlen,
+            has_rx_qlen=gctx.has_rx_qlen, has_aqm=gctx.has_aqm,
         )
         jitter_vv = gctx.jitter_vv
 
@@ -191,6 +191,7 @@ class ShardedEngine:
                 **flags,
             )
             handlers = model.make_handlers(ctx)
+            pre_window = getattr(model, "make_pre_window", lambda c: None)(ctx)
 
             def exchange(fp: FlatPackets):
                 # The one collective per window (SURVEY §2.5): bucket local
@@ -247,7 +248,9 @@ class ShardedEngine:
 
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
-                0, n_windows, lambda _, s: window_step(s, ctx, handlers, exchange), st
+                0, n_windows,
+                lambda _, s: window_step(s, ctx, handlers, exchange, pre_window),
+                st,
             )
             # Each shard accumulated its own partials on top of the (replicated)
             # input metrics; psum then re-subtract the duplicated baseline.

@@ -170,7 +170,7 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
-        ctx.tx_qlen_ns if ctx.has_qlen else None,
+        ctx.tx_qlen_ns if ctx.has_tx_qlen else None,
         aqm=ctx_aqm(ctx),
     )
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)

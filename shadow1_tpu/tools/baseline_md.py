@@ -12,7 +12,6 @@ measured baseline this repo produces for itself).
 from __future__ import annotations
 
 import json
-import subprocess
 import sys
 
 
@@ -36,6 +35,8 @@ def fmt(row: dict) -> str:
     note = []
     if row.get("status") == "budget":
         note.append("budget-capped")
+    if row.get("status") == "fault":
+        note.append("GAVE UP on device faults (partial)")
     if row.get("process_respawns"):
         note.append(f"{row['process_respawns']} fault-resumes")
     if row.get("round_cap_hits"):
@@ -43,20 +44,25 @@ def fmt(row: dict) -> str:
     if row.get("oracle_events_per_sec"):
         note.append(f"oracle {row['oracle_events_per_sec']:,.0f} ev/s"
                     f" on {row['oracle_windows']} win")
+    eps = row.get("events_per_sec")
+    spw = row.get("sim_per_wall")
     return (
         f"| {row['rung']} | {row['n_hosts']:,} | {win} "
-        f"| {row['events']:,} | **{row['events_per_sec']:,.0f}** "
-        f"| {row['sim_per_wall']:.3f} | {row['wall_s']:.0f} + "
+        f"| {row['events']:,} "
+        f"| {'**' + format(eps, ',.0f') + '**' if eps is not None else '—'} "
+        f"| {format(spw, '.3f') if spw is not None else '—'} "
+        f"| {row['wall_s']:.0f} + "
         f"{row['compile_s']:.0f}c | {over} | {'; '.join(note) or '—'} |"
     )
 
 
 def main() -> None:
     rows = load_rows(sys.argv[1:])
-    commit = subprocess.run(
-        ["git", "rev-parse", "--short", "HEAD"], capture_output=True, text=True
-    ).stdout.strip() or "?"
-    print(f"Measured on the single axon TPU v5 lite chip, commit {commit}; "
+    # Provenance comes from the rows (stamped by bench_ladder at measurement
+    # time); rendering later must not claim the current HEAD.
+    commits = sorted({r.get("commit", "?") for r in rows.values()})
+    print(f"Measured on the single axon TPU v5 lite chip, "
+          f"commit(s) {', '.join(commits)}; "
           f"walls in seconds, compile excluded ('+ Nc' column).")
     print()
     print("| rung | hosts | windows | events | events/s | sim/wall "
