@@ -34,6 +34,7 @@ model_cfg:
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import (
@@ -142,26 +143,38 @@ def on_wakeup(st, ctx, ev, mask):
     hh = jnp.arange(ctx.n_hosts)
     zero = jnp.zeros(ctx.n_hosts, jnp.int32)
 
-    # OP_CONNECT_ONE: dial neighbor slot j = p1 on socket 1+j.
+    # OP_CONNECT_ONE: dial neighbor slot j = p1 on socket 1+j. Startup-only
+    # (one per neighbor edge) but carries a tcp_connect — lax.cond keeps it
+    # out of steady-state gossip rounds (exact: all writes masked).
     conn = mask & (op == OP_CONNECT_ONE)
-    j = jnp.where(conn, ev.p[:, 1], 0)
-    peer = app["peers"][hh, jnp.minimum(j, k_max - 1)]
-    sock = (1 + j).astype(jnp.int32)
-    napp = dict(app)
-    napp["nbr_sock"] = napp["nbr_sock"].at[hh, jnp.where(conn, j, k_max)].set(
-        sock, mode="drop"
-    )
-    st = st._replace(model=st.model._replace(app=napp))
-    st = T.tcp_connect(st, ctx, conn, sock, peer, zero, ev.time)
 
-    # OP_TX_CREATE: origin marks the tx seen and queues the announcements.
+    def _op_conn(st):
+        app = st.model.app
+        j = jnp.where(conn, ev.p[:, 1], 0)
+        peer = app["peers"][hh, jnp.minimum(j, k_max - 1)]
+        sock = (1 + j).astype(jnp.int32)
+        napp = dict(app)
+        napp["nbr_sock"] = napp["nbr_sock"].at[hh, jnp.where(conn, j, k_max)].set(
+            sock, mode="drop"
+        )
+        st = st._replace(model=st.model._replace(app=napp))
+        return T.tcp_connect(st, ctx, conn, sock, peer, zero, ev.time)
+
+    st = jax.lax.cond(conn.any(), _op_conn, lambda s: s, st)
+
+    # OP_TX_CREATE: origin marks the tx seen and queues the announcements
+    # (a few hundred per run — cond-gated).
     create = mask & (op == OP_TX_CREATE)
-    txid = ev.p[:, 1]
-    app = dict(st.model.app)
-    app, new = _mark_seen(app, create, txid, ev.time)
-    st = st._replace(model=st.model._replace(app=app))
-    none = jnp.full(ctx.n_hosts, -1, jnp.int32)
-    st = _announce(st, ctx, new, txid, none, ev.time)
+
+    def _op_create(st):
+        txid = ev.p[:, 1]
+        app = dict(st.model.app)
+        app, new = _mark_seen(app, create, txid, ev.time)
+        st = st._replace(model=st.model._replace(app=app))
+        none = jnp.full(ctx.n_hosts, -1, jnp.int32)
+        return _announce(st, ctx, new, txid, none, ev.time)
+
+    st = jax.lax.cond(create.any(), _op_create, lambda s: s, st)
 
     # OP_TX_MSG: the single transport-send site. Admission: the message must
     # fit the send buffer and a boundary slot must be free, else retry at the
@@ -195,16 +208,21 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     tx_size = int(ctx.model_cfg.get("tx_size", 400))
     inv_size = int(ctx.model_cfg.get("inv_size", 36))
 
-    # Inbound conn accepted: bind it to its neighbor slot.
+    # Inbound conn accepted: bind it to its neighbor slot (startup-only;
+    # the k-slot scan is cond-gated out of steady-state gossip rounds).
     acc = mask & ((f & N_ACCEPTED) != 0)
-    app = dict(st.model.app)
-    peer = st.model.tcp["peer_host"][hh, jnp.where(acc, sock, 0)]
-    for j in range(app["peers"].shape[1]):
-        m = acc & (app["peers"][:, j] == peer) & (app["nbr_sock"][:, j] < 0)
-        app["nbr_sock"] = app["nbr_sock"].at[:, j].set(
-            jnp.where(m, sock, app["nbr_sock"][:, j])
-        )
-    st = st._replace(model=st.model._replace(app=app))
+
+    def _accepted(st):
+        app = dict(st.model.app)
+        peer = st.model.tcp["peer_host"][hh, jnp.where(acc, sock, 0)]
+        for j in range(app["peers"].shape[1]):
+            m = acc & (app["peers"][:, j] == peer) & (app["nbr_sock"][:, j] < 0)
+            app["nbr_sock"] = app["nbr_sock"].at[:, j].set(
+                jnp.where(m, sock, app["nbr_sock"][:, j])
+            )
+        return st._replace(model=st.model._replace(app=app))
+
+    st = jax.lax.cond(acc.any(), _accepted, lambda s: s, st)
 
     # Protocol messages (one boundary per host-round at most).
     msg = mask & ((f & N_MSG) != 0)

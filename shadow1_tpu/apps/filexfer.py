@@ -16,6 +16,7 @@ model_cfg (numpy arrays, [H]):
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import (
@@ -114,20 +115,30 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     app["flows_done"] = app["flows_done"] + msg.astype(jnp.int32)
     st = st._replace(model=st.model._replace(app=app))
 
-    # Server: peer finished → close our side (full teardown).
+    # Server: peer finished → close our side (full teardown). Teardown-only
+    # blocks run under lax.cond (tcp_close / tcp_connect are the heavy ops;
+    # gating is exact since all writes are masked).
     peer_fin = mask & is_server & ((f & N_PEER_FIN) != 0)
-    st = T.tcp_close(st, ctx, peer_fin, nf.sock, now)
+    st = jax.lax.cond(
+        peer_fin.any(),
+        lambda s: T.tcp_close(s, ctx, peer_fin, nf.sock, now),
+        lambda s: s, st,
+    )
 
     # Client: connection fully closed → next flow or done.
-    app = dict(st.model.app)
     closed = mask & is_client & ((f & N_CLOSED) != 0)
-    app["flows_left"] = app["flows_left"] - closed.astype(jnp.int32)
-    again = closed & (app["flows_left"] > 0)
-    app["done_time"] = jnp.where(
-        closed & (app["flows_left"] == 0), now, app["done_time"]
-    )
-    st = st._replace(model=st.model._replace(app=app))
-    return _client_start(st, ctx, again, now)
+
+    def _closed(st):
+        app = dict(st.model.app)
+        app["flows_left"] = app["flows_left"] - closed.astype(jnp.int32)
+        again = closed & (app["flows_left"] > 0)
+        app["done_time"] = jnp.where(
+            closed & (app["flows_left"] == 0), now, app["done_time"]
+        )
+        st = st._replace(model=st.model._replace(app=app))
+        return _client_start(st, ctx, again, now)
+
+    return jax.lax.cond(closed.any(), _closed, lambda s: s, st)
 
 
 def summary(app) -> dict:

@@ -23,6 +23,7 @@ model_cfg (numpy arrays, [H] unless noted):
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu import rng
@@ -136,27 +137,36 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     app["streams_served"] = app["streams_served"] + msg.astype(jnp.int32)
     st = st._replace(model=st.model._replace(app=app))
 
-    # Server: peer finished → close our side.
+    # Server: peer finished → close our side. Teardown blocks are lax.cond-
+    # gated out of steady-state rounds (exact: all writes masked).
     peer_fin = srv & ((f & N_PEER_FIN) != 0)
-    st = T.tcp_close(st, ctx, peer_fin, nf.sock, now)
+    st = jax.lax.cond(
+        peer_fin.any(),
+        lambda s: T.tcp_close(s, ctx, peer_fin, nf.sock, now),
+        lambda s: s, st,
+    )
 
     # Client: stream fully closed → think, then next stream (or done).
-    app = dict(st.model.app)
     closed = mask & is_client_sock & ((f & N_CLOSED) != 0)
-    app["streams_left"] = app["streams_left"] - closed.astype(jnp.int32)
-    app["streams_done"] = app["streams_done"] + closed.astype(jnp.int32)
-    again = closed & (app["streams_left"] > 0)
-    app["done_time"] = jnp.where(
-        closed & (app["streams_left"] == 0), now, app["done_time"]
-    )
-    # Think draw belongs to the stream just completed: ctr was advanced at
-    # start, so its index is ctr - 1.
-    think_ctr = 3 * (app["ctr"] - 1) + 2
-    think = rng.exponential_ns(
-        rng.bits_v(ctx.key, R_APP, ctx.hosts, think_ctr), app["mean_think"]
-    )
-    st = st._replace(model=st.model._replace(app=app))
-    return push_local_event(st, ctx, again, now + think, K_APP, p0=OP_START)
+
+    def _closed(st):
+        app = dict(st.model.app)
+        app["streams_left"] = app["streams_left"] - closed.astype(jnp.int32)
+        app["streams_done"] = app["streams_done"] + closed.astype(jnp.int32)
+        again = closed & (app["streams_left"] > 0)
+        app["done_time"] = jnp.where(
+            closed & (app["streams_left"] == 0), now, app["done_time"]
+        )
+        # Think draw belongs to the stream just completed: ctr was advanced
+        # at start, so its index is ctr - 1.
+        think_ctr = 3 * (app["ctr"] - 1) + 2
+        think = rng.exponential_ns(
+            rng.bits_v(ctx.key, R_APP, ctx.hosts, think_ctr), app["mean_think"]
+        )
+        st = st._replace(model=st.model._replace(app=app))
+        return push_local_event(st, ctx, again, now + think, K_APP, p0=OP_START)
+
+    return jax.lax.cond(closed.any(), _closed, lambda s: s, st)
 
 
 def summary(app) -> dict:

@@ -47,6 +47,7 @@ model_cfg:
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu import rng
@@ -394,16 +395,24 @@ def on_wakeup(st, ctx, ev, mask):
     zero = jnp.zeros(ctx.n_hosts, jnp.int32)
     t = tables(ctx.model_cfg)
 
-    # OP_START: client dials a dirauth on sock 2.
+    # OP_START: client dials a dirauth on sock 2. Rare (one per client
+    # bootstrap) but carries a tcp_connect — lax.cond keeps it out of every
+    # steady-state K_APP round (same for the other rare opcodes below; a
+    # cond whose block is fully masked is a no-op by construction, so the
+    # gating is exact).
     start = mask & (op == OP_START)
-    app = dict(st.model.app)
-    b = _draw_bits(ctx, app, start)
-    d_idx = rng.randint(b, len(t["dir_ids"]))
-    dirauth = jnp.asarray(t["dir_ids"])[d_idx]
-    app["cl_state"] = jnp.where(start, CL_DIR_CONN, app["cl_state"])
-    st = st._replace(model=st.model._replace(app=app))
     two = jnp.full(ctx.n_hosts, 2, jnp.int32)
-    st = T.tcp_connect(st, ctx, start, two, dirauth, zero, now)
+
+    def _op_start(st):
+        app = dict(st.model.app)
+        b = _draw_bits(ctx, app, start)
+        d_idx = rng.randint(b, len(t["dir_ids"]))
+        dirauth = jnp.asarray(t["dir_ids"])[d_idx]
+        app["cl_state"] = jnp.where(start, CL_DIR_CONN, app["cl_state"])
+        st = st._replace(model=st.model._replace(app=app))
+        return T.tcp_connect(st, ctx, start, two, dirauth, zero, now)
+
+    st = jax.lax.cond(start.any(), _op_start, lambda s: s, st)
 
     # OP_TX_CELL: the single transport-send site. Admission: the full
     # message must fit the send buffer and a boundary slot must be free;
@@ -430,33 +439,45 @@ def on_wakeup(st, ctx, ev, mask):
 
     # OP_CONNECT_RELAY: dial an onward relay conn.
     dial = mask & (op == OP_CONNECT_RELAY)
-    st = T.tcp_connect(st, ctx, dial, ev.p[:, 1], ev.p[:, 2], zero, now)
+    st = jax.lax.cond(
+        dial.any(),
+        lambda s: T.tcp_connect(s, ctx, dial, ev.p[:, 1], ev.p[:, 2], zero, now),
+        lambda s: s, st,
+    )
 
     # OP_DRAIN: send one pending CREATE on an established conn; loop while
     # more remain.
     drain = mask & (op == OP_DRAIN)
-    sock = ev.p[:, 1]
-    app = dict(st.model.app)
-    ct = app["ct_used"].shape[1]
-    pend = app["ct_used"] & app["ct_pend"] & (app["ct_out_sock"] == sock[:, None])
-    has = drain & pend.any(axis=1)
-    idx = jnp.argmax(pend, axis=1)
-    ocirc = app["ct_out_circ"][hh, idx]
-    app["ct_pend"] = app["ct_pend"].at[hh, jnp.where(has, idx, ct)].set(
-        False, mode="drop"
-    )
-    more = drain & (pend.sum(axis=1) > 1)
-    st = st._replace(model=st.model._replace(app=app))
-    st = _push_cell(st, ctx, has, sock, _meta(ocirc, 0, C_CREATE), CELL, now)
-    st = push_local_event(st, ctx, more, now, K_APP, p0=OP_DRAIN, p1=sock)
+
+    def _op_drain(st):
+        sock = ev.p[:, 1]
+        app = dict(st.model.app)
+        ct = app["ct_used"].shape[1]
+        pend = app["ct_used"] & app["ct_pend"] & (app["ct_out_sock"] == sock[:, None])
+        has = drain & pend.any(axis=1)
+        idx = jnp.argmax(pend, axis=1)
+        ocirc = app["ct_out_circ"][hh, idx]
+        app["ct_pend"] = app["ct_pend"].at[hh, jnp.where(has, idx, ct)].set(
+            False, mode="drop"
+        )
+        more = drain & (pend.sum(axis=1) > 1)
+        st = st._replace(model=st.model._replace(app=app))
+        st = _push_cell(st, ctx, has, sock, _meta(ocirc, 0, C_CREATE), CELL, now)
+        return push_local_event(st, ctx, more, now, K_APP, p0=OP_DRAIN, p1=sock)
+
+    st = jax.lax.cond(drain.any(), _op_drain, lambda s: s, st)
 
     # OP_THINK: next stream on this circuit, or next circuit.
     think = mask & (op == OP_THINK)
-    app = st.model.app
-    next_stream = think & (app["cl_streams_left"] > 0)
-    st = _client_begin_stream(st, ctx, next_stream, now)
-    next_circ = think & ~next_stream & (st.model.app["cl_circs_left"] > 0)
-    return _client_begin_circuit(st, ctx, next_circ, now)
+
+    def _op_think(st):
+        app = st.model.app
+        next_stream = think & (app["cl_streams_left"] > 0)
+        st2 = _client_begin_stream(st, ctx, next_stream, now)
+        next_circ = think & ~next_stream & (st2.model.app["cl_circs_left"] > 0)
+        return _client_begin_circuit(st2, ctx, next_circ, now)
+
+    return jax.lax.cond(think.any(), _op_think, lambda s: s, st)
 
 
 def on_notify(st, ctx, nf: T.Notif, now, mask):
@@ -472,12 +493,21 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     t = tables(ctx.model_cfg)
     app = st.model.app
 
+    # Client bootstrap and circuit-build blocks run under lax.cond: each
+    # fires a handful of times per client ever, but carries tcp_connect /
+    # tcp_close / weighted-draw machinery that every notify round would
+    # otherwise pay for (the gating is exact — all writes are masked).
+
     # Client: dirauth conn up → request the consensus.
     dir_up = mask & is_client & est & (sock == 2) & (app["cl_state"] == CL_DIR_CONN)
-    napp = dict(app)
-    napp["cl_state"] = jnp.where(dir_up, CL_DIR_FETCH, napp["cl_state"])
-    st = st._replace(model=st.model._replace(app=napp))
-    st = _push_cell(st, ctx, dir_up, two, _meta(0, 0, C_DIRREQ), CELL, now)
+
+    def _dir_up(st):
+        napp = dict(st.model.app)
+        napp["cl_state"] = jnp.where(dir_up, CL_DIR_FETCH, napp["cl_state"])
+        st = st._replace(model=st.model._replace(app=napp))
+        return _push_cell(st, ctx, dir_up, two, _meta(0, 0, C_DIRREQ), CELL, now)
+
+    st = jax.lax.cond(dir_up.any(), _dir_up, lambda s: s, st)
 
     # Client: consensus received → close dir conn, dial the drawn guard.
     app = st.model.app
@@ -485,24 +515,32 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
         mask & is_client & msg & (sock == 2) & (cmd == C_DIRRESP)
         & (app["cl_state"] == CL_DIR_FETCH)
     )
-    napp = dict(app)
-    guard = _pick_weighted(
-        _draw_bits(ctx, napp, got_dir), t["guard_ids"], t["guard_cum"]
-    )
-    napp["cl_guard"] = jnp.where(got_dir, guard, napp["cl_guard"])
-    napp["bootstrap_time"] = jnp.where(got_dir, now, napp["bootstrap_time"])
-    napp["cl_state"] = jnp.where(got_dir, CL_GUARD_CONN, napp["cl_state"])
-    st = st._replace(model=st.model._replace(app=napp))
-    st = T.tcp_close(st, ctx, got_dir, two, now)
-    zero = jnp.zeros(ctx.n_hosts, jnp.int32)
-    st = T.tcp_connect(st, ctx, got_dir, one, guard, zero, now)
+
+    def _got_dir(st):
+        napp = dict(st.model.app)
+        guard = _pick_weighted(
+            _draw_bits(ctx, napp, got_dir), t["guard_ids"], t["guard_cum"]
+        )
+        napp["cl_guard"] = jnp.where(got_dir, guard, napp["cl_guard"])
+        napp["bootstrap_time"] = jnp.where(got_dir, now, napp["bootstrap_time"])
+        napp["cl_state"] = jnp.where(got_dir, CL_GUARD_CONN, napp["cl_state"])
+        st = st._replace(model=st.model._replace(app=napp))
+        st = T.tcp_close(st, ctx, got_dir, two, now)
+        zero = jnp.zeros(ctx.n_hosts, jnp.int32)
+        return T.tcp_connect(st, ctx, got_dir, one, guard, zero, now)
+
+    st = jax.lax.cond(got_dir.any(), _got_dir, lambda s: s, st)
 
     # Client: guard conn up → first circuit.
     app = st.model.app
     guard_up = (
         mask & is_client & est & (sock == 1) & (app["cl_state"] == CL_GUARD_CONN)
     )
-    st = _client_begin_circuit(st, ctx, guard_up, now)
+    st = jax.lax.cond(
+        guard_up.any(),
+        lambda s: _client_begin_circuit(s, ctx, guard_up, now),
+        lambda s: s, st,
+    )
 
     # Client: circuit-build and stream cells on the guard conn.
     app = st.model.app
@@ -511,18 +549,25 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     creatd = cl_msg & (cmd == C_CREATED) & (hop == 1)
     ext2 = cl_msg & (cmd == C_EXTENDED) & (hop == 2)
     ext3 = cl_msg & (cmd == C_EXTENDED) & (hop == 3)
-    napp = dict(app)
-    napp["cl_hop"] = jnp.where(creatd | ext2, hop + 1, napp["cl_hop"])
-    st = st._replace(model=st.model._replace(app=napp))
-    st = _push_cell(
-        st, ctx, creatd, one, _meta(app["cl_circ"], app["cl_mid"], C_EXTEND),
-        CELL, now,
+
+    def _circ_build(st):
+        app = st.model.app
+        napp = dict(app)
+        napp["cl_hop"] = jnp.where(creatd | ext2, hop + 1, napp["cl_hop"])
+        st = st._replace(model=st.model._replace(app=napp))
+        st = _push_cell(
+            st, ctx, creatd, one, _meta(app["cl_circ"], app["cl_mid"], C_EXTEND),
+            CELL, now,
+        )
+        st = _push_cell(
+            st, ctx, ext2, one, _meta(app["cl_circ"], app["cl_exit"], C_EXTEND),
+            CELL, now,
+        )
+        return _client_begin_stream(st, ctx, ext3, now)
+
+    st = jax.lax.cond(
+        (creatd | ext2 | ext3).any(), _circ_build, lambda s: s, st
     )
-    st = _push_cell(
-        st, ctx, ext2, one, _meta(app["cl_circ"], app["cl_exit"], C_EXTEND),
-        CELL, now,
-    )
-    st = _client_begin_stream(st, ctx, ext3, now)
 
     # Client: stream data/end.
     app = st.model.app
@@ -543,11 +588,15 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     # Dirauth: serve consensus requests; reap disconnected clients.
     consensus_bytes = int(ctx.model_cfg.get("consensus_bytes", 2048))
     dreq = mask & (role == 2) & msg & (cmd == C_DIRREQ)
-    st = _push_cell(
-        st, ctx, dreq, sock, _meta(0, 0, C_DIRRESP), consensus_bytes, now
-    )
     d_fin = mask & (role == 2) & ((f & N_PEER_FIN) != 0)
-    st = T.tcp_close(st, ctx, d_fin, sock, now)
+
+    def _dirauth(st):
+        st = _push_cell(
+            st, ctx, dreq, sock, _meta(0, 0, C_DIRRESP), consensus_bytes, now
+        )
+        return T.tcp_close(st, ctx, d_fin, sock, now)
+
+    st = jax.lax.cond((dreq | d_fin).any(), _dirauth, lambda s: s, st)
 
     # Relay: onward conn established → drain pending CREATEs.
     app = st.model.app

@@ -34,6 +34,16 @@ K_TX_RESUME = 5   # continue flushing a socket's send buffer (burst bound)
 K_APP = 6         # application state-machine wakeup (p0 = app opcode)
 N_KINDS = 7
 
+# Per-kind occupancy metric fields shared by both engines (kind →
+# (pops-field, fires-field)): one table so the engines cannot drift.
+KIND_METRIC_FIELDS = {
+    K_PKT: ("pops_pkt", "fires_pkt"),
+    K_PKT_DELIVER: ("pops_deliver", "fires_deliver"),
+    K_TCP_TIMER: ("pops_timer", "fires_timer"),
+    K_TX_RESUME: ("pops_txr", "fires_txr"),
+    K_APP: ("pops_app", "fires_app"),
+}
+
 # Number of i32 payload columns on every event record.
 NP = 10
 

@@ -29,7 +29,13 @@ import numpy as np
 
 from shadow1_tpu import rng
 from shadow1_tpu.config.compiled import CompiledExperiment
-from shadow1_tpu.consts import R_JITTER, R_LOSS, EngineParams, packet_tb
+from shadow1_tpu.consts import (
+    KIND_METRIC_FIELDS,
+    R_JITTER,
+    R_LOSS,
+    EngineParams,
+    packet_tb,
+)
 from shadow1_tpu.core.events import (
     EventBuf,
     Popped,
@@ -62,6 +68,21 @@ class Metrics(NamedTuple):
     nic_tx_drops: jnp.ndarray    # packets dropped: NIC uplink queue full
     nic_rx_drops: jnp.ndarray    # packets dropped: NIC downlink queue full
     nic_aqm_drops: jnp.ndarray   # packets dropped: RED early-drop (uplink)
+    # Per-kind pop occupancy (performance observability: which handler
+    # passes the rounds actually feed; parity-exact like events).
+    pops_pkt: jnp.ndarray        # K_PKT (rx drop-tail path only)
+    pops_deliver: jnp.ndarray    # K_PKT_DELIVER
+    pops_timer: jnp.ndarray      # K_TCP_TIMER
+    pops_txr: jnp.ndarray        # K_TX_RESUME
+    pops_app: jnp.ndarray        # K_APP
+    # Rounds in which each handler pass FIRED (its lax.cond took the real
+    # branch) — the per-round cost drivers. Batch-engine-only counters,
+    # excluded from parity like ``rounds``.
+    fires_pkt: jnp.ndarray
+    fires_deliver: jnp.ndarray
+    fires_timer: jnp.ndarray
+    fires_txr: jnp.ndarray
+    fires_app: jnp.ndarray
 
 
 def _metrics_init() -> Metrics:
@@ -228,11 +249,16 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
         m = m._replace(ev_overflow=m.ev_overflow + over.sum(dtype=jnp.int64))
         ev = ev._replace(mask=run, time=jnp.where(run, eff, ev.time),
                          kind=jnp.where(defer, 0, ev.kind))
+    pops = {
+        f[0]: getattr(m, f[0]) + (ev.mask & (ev.kind == k)).sum(dtype=jnp.int64)
+        for k, f in KIND_METRIC_FIELDS.items() if k in handlers
+    }
     st = st._replace(
         metrics=m._replace(
             events=m.events + ev.mask.sum(dtype=jnp.int64),
             rounds=m.rounds + 1,
             down_events=m.down_events + n_down,
+            **pops,
         ),
     )
     items = sorted(handlers.items())
@@ -241,6 +267,12 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
             st = fn(st, ev)
         else:
             present = (ev.mask & (ev.kind == kind)).any()
+            if kind in KIND_METRIC_FIELDS:
+                fires = KIND_METRIC_FIELDS[kind][1]
+                m2 = st.metrics
+                st = st._replace(metrics=m2._replace(**{
+                    fires: getattr(m2, fires) + present.astype(jnp.int64)
+                }))
             st = jax.lax.cond(present, fn, lambda s, _e: s, st, ev)
     return st
 

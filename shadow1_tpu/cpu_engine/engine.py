@@ -21,6 +21,7 @@ import numpy as np
 
 from shadow1_tpu.config.compiled import CompiledExperiment
 from shadow1_tpu.consts import (
+    KIND_METRIC_FIELDS,
     K_PHOLD,
     K_PKT,
     R_JITTER,
@@ -80,7 +81,14 @@ class CpuEngine:
             "nic_tx_drops": 0,
             "nic_rx_drops": 0,
             "nic_aqm_drops": 0,
+            "pops_pkt": 0,
+            "pops_deliver": 0,
+            "pops_timer": 0,
+            "pops_txr": 0,
+            "pops_app": 0,
         }
+        # Per-kind pop occupancy fields (shared table — consts).
+        self._pops_field = {k: f[0] for k, f in KIND_METRIC_FIELDS.items()}
         self.model = self._make_model()
         self.model.start()
 
@@ -163,6 +171,7 @@ class CpuEngine:
     # -- main loop ---------------------------------------------------------
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
         end = (self.n_windows if n_windows is None else n_windows) * self.window
+        rx_batch = getattr(self.model, "rx_batch", False)
         while self.heap and self.heap[0][0] < end:
             time, tb, _g, host, kind, p = heapq.heappop(self.heap)
             self.pending[host] -= 1
@@ -173,7 +182,7 @@ class CpuEngine:
             # NIC arrival fast path: rx processing is plumbing, not an event
             # — no event count, no virtual-CPU charge (mirror of the batched
             # engine's window-start conversion, net.make_pre_window).
-            if kind == K_PKT and getattr(self.model, "rx_batch", False):
+            if kind == K_PKT and rx_batch:
                 self.model.rx_convert(host, time, tb, p)
                 continue
             # virtual CPU (host/cpu.c): execute at eff = max(time, busy); an
@@ -189,6 +198,9 @@ class CpuEngine:
                 self.cpu_busy[host] = eff + int(self.cpu_cost[host])
                 time = eff
             self.metrics["events"] += 1
+            f = self._pops_field.get(kind)
+            if f:
+                self.metrics[f] += 1
             self.model.handle(host, time, kind, p)
         return dict(self.metrics)
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import (
@@ -424,28 +425,37 @@ def tcp_rx(st, ctx, mask, p, now):
     is_fin = (flags & F_FIN) != 0
     nf = notif_none(H)
 
-    # ---- passive open: SYN → LISTEN socket spawns a child (tcp.c accept path)
+    # ---- passive open: SYN → LISTEN socket spawns a child (tcp.c accept
+    # path). Whole block under lax.cond: bare SYNs exist only while
+    # connections open, and the block carries a full tcp_flush (the SYN|ACK
+    # emit) — dead weight in every steady-state deliver round otherwise.
     tcp = st.model.tcp
     r0 = Sock(tcp, ds, mask)
     syn_to_listen = mask & is_syn & ~is_ack & (r0.g("st") == TCP_LISTEN)
-    dup = (
-        (tcp["peer_host"] == src[:, None])
-        & (tcp["peer_sock"] == ss[:, None])
-        & (tcp["st"] != TCP_FREE)
-        & (tcp["st"] != TCP_LISTEN)
-    ).any(axis=1)
-    free = tcp["st"] == TCP_FREE
-    # Children take the HIGHEST free slot: low slots are app-owned (0 =
-    # listener, 1 = client socket on dual-role hosts) and may be TCP_FREE
-    # between uses — allocating from the top keeps them unclobbered.
-    n_s = free.shape[1]
-    child = (n_s - 1 - jnp.argmax(free[:, ::-1], axis=1)).astype(jnp.int32)
-    new_conn = syn_to_listen & ~dup & free.any(axis=1)
-    rc = Sock(tcp, child, new_conn)
-    _init_conn(rc, ctx, new_conn, src, ss, TCP_SYN_RCVD, 1)
-    rc.s("peer_wnd", wnd, new_conn)
-    st = st._replace(model=st.model._replace(tcp=rc.d))
-    st = tcp_flush(st, ctx, new_conn, child, now)  # emits SYN|ACK
+
+    def _accept(st):
+        tcp = st.model.tcp
+        dup = (
+            (tcp["peer_host"] == src[:, None])
+            & (tcp["peer_sock"] == ss[:, None])
+            & (tcp["st"] != TCP_FREE)
+            & (tcp["st"] != TCP_LISTEN)
+        ).any(axis=1)
+        free = tcp["st"] == TCP_FREE
+        # Children take the HIGHEST free slot: low slots are app-owned (0 =
+        # listener, 1 = client socket on dual-role hosts) and may be
+        # TCP_FREE between uses — allocating from the top keeps them
+        # unclobbered.
+        n_s = free.shape[1]
+        child = (n_s - 1 - jnp.argmax(free[:, ::-1], axis=1)).astype(jnp.int32)
+        new_conn = syn_to_listen & ~dup & free.any(axis=1)
+        rc = Sock(tcp, child, new_conn)
+        _init_conn(rc, ctx, new_conn, src, ss, TCP_SYN_RCVD, 1)
+        rc.s("peer_wnd", wnd, new_conn)
+        st = st._replace(model=st.model._replace(tcp=rc.d))
+        return tcp_flush(st, ctx, new_conn, child, now)  # emits SYN|ACK
+
+    st = jax.lax.cond(syn_to_listen.any(), _accept, lambda s: s, st)
 
     # ---- established-path demux: peer must match (guards stale/reused slots)
     r = Sock(st.model.tcp, ds, mask)
@@ -541,18 +551,30 @@ def tcp_rx(st, ctx, mask, p, now):
     nf = _notify(nf, in_order, ds, N_DATA, dlen=length)
     msg = in_order & (mend != 0)
     nf = _notify(nf, msg, ds, N_MSG, meta=mmeta)
-    # FIN: in order once preceding data (if any) is consumed.
-    fin_here = v & is_fin & ((seq + length) == r.g("rcv_nxt")) & _state_in(
-        state2, (TCP_ESTABLISHED, TCP_FIN_WAIT_1, TCP_FIN_WAIT_2)
+    # FIN: in order once preceding data (if any) is consumed. The teardown
+    # block runs under lax.cond — FINs appear only at stream close, and
+    # every deliver round otherwise paid its state machinery for nothing.
+    def _fin(rd, nf):
+        r2 = Sock(rd, ds, mask)
+        fin_here = v & is_fin & ((seq + length) == r2.g("rcv_nxt")) & _state_in(
+            state2, (TCP_ESTABLISHED, TCP_FIN_WAIT_1, TCP_FIN_WAIT_2)
+        )
+        r2.s("rcv_nxt", r2.g("rcv_nxt") + 1, fin_here)
+        to_cw = fin_here & (state2 == TCP_ESTABLISHED)
+        r2.s("st", TCP_CLOSE_WAIT, to_cw)
+        nf2 = _notify(nf, to_cw, ds, N_PEER_FIN)
+        to_closing = fin_here & (state2 == TCP_FIN_WAIT_1)
+        r2.s("st", TCP_CLOSING, to_closing)
+        closed_by_fin = fin_here & (state2 == TCP_FIN_WAIT_2)
+        nf2 = _notify(nf2, closed_by_fin, ds, N_CLOSED)
+        return r2.d, nf2, closed_by_fin
+
+    rd, nf, closed_by_fin = jax.lax.cond(
+        (v & is_fin).any(), _fin,
+        lambda rd, nf: (rd, nf, jnp.zeros_like(v)),
+        dict(r.d), nf,
     )
-    r.s("rcv_nxt", r.g("rcv_nxt") + 1, fin_here)
-    to_cw = fin_here & (state2 == TCP_ESTABLISHED)
-    r.s("st", TCP_CLOSE_WAIT, to_cw)
-    nf = _notify(nf, to_cw, ds, N_PEER_FIN)
-    to_closing = fin_here & (state2 == TCP_FIN_WAIT_1)
-    r.s("st", TCP_CLOSING, to_closing)
-    closed_by_fin = fin_here & (state2 == TCP_FIN_WAIT_2)
-    nf = _notify(nf, closed_by_fin, ds, N_CLOSED)
+    r = Sock(rd, ds, mask)
 
     # Free fully-closed sockets (slot reuse; stale packets are dropped by the
     # peer-match guard above).

@@ -16,6 +16,9 @@ from shadow1_tpu.cpu_engine import CpuEngine
 PARITY_KEYS = [
     "events", "pkts_sent", "pkts_delivered", "pkts_lost",
     "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+    # per-kind pop occupancy: parity-exact like events (guards the rx
+    # fast-path split staying symmetric between engines)
+    "pops_pkt", "pops_deliver", "pops_timer", "pops_txr", "pops_app",
 ]
 
 
