@@ -16,10 +16,18 @@ cross-thread event push (src/main/utility/async-priority-queue.c).
 Exchanged bytes scale with the per-destination bucket capacity
 (``EngineParams.x2x_cap``, auto-sized to 2× the uniform-traffic
 expectation), NOT with ×n_dev as the earlier all_gather did. Bucket-full
-drops are counted in ``x2x_overflow``; ``run()`` raises by default when
-any occurred (``check_x2x=False`` to opt out), because a silent drop in
-the collective would quietly break the determinism contract the
-all_gather held by construction.
+drops are counted in ``x2x_overflow``. When the cap was auto-sized and a
+bucket overflows — which the flagship *convergent* workloads (every
+client → one server; Tor clients → few relays) can always do, since one
+bucket may need the shard's entire outbox — ``run()`` retries the same
+run from the same (immutable) input state at the guaranteed-fit cap
+``h_local·outbox_cap``, so results are exact and never silently lossy;
+an explicitly-set cap that overflows raises instead (the user's knob is
+a contract). The retry costs one recompile; pass an explicit cap to
+pin the exchange size for perf-critical runs. Caps beyond
+``h_local·outbox_cap`` are clamped to it — a bucket physically cannot
+hold more than the shard's whole outbox, so larger values only waste
+exchange bytes.
 
 Determinism across shardings: within a shard's outbound, the bucket sort is
 stable in flat source order and received buckets concatenate in
@@ -101,8 +109,17 @@ class ShardedEngine:
             **fidelity_ctx_kwargs(exp),
         )
         self._model = _model_module(exp.model)
+        # Per-(src→dst shard) bucket capacity. The worst case is convergent
+        # traffic: ONE bucket holding the shard's entire outbox, so
+        # ``_full_cap`` always fits by construction. The auto default is 2×
+        # the uniform-traffic expectation (cheap exchange); run() escalates
+        # to _full_cap on overflow.
+        self._full_cap = self.h_local * self.params.outbox_cap
+        auto = max(16, -(-2 * self._full_cap // self.n_dev))
+        self._x2x_cap = min(self.params.x2x_cap or auto, self._full_cap)
         # n_windows traced: one compiled program for every window count.
-        self._run_jit = jax.jit(self._make_run())
+        # Keyed by bucket cap (the overflow-retry path recompiles once).
+        self._run_jits: dict[int, object] = {}
 
     # -- sharding specs ----------------------------------------------------
     def _spec_for(self, leaf) -> P:
@@ -135,7 +152,13 @@ class ShardedEngine:
         return jax.device_put(st, shardings)
 
     # -- the sharded program ----------------------------------------------
-    def _make_run(self):
+    def _get_run(self, x2x_cap: int):
+        f = self._run_jits.get(x2x_cap)
+        if f is None:
+            f = self._run_jits[x2x_cap] = jax.jit(self._make_run(x2x_cap))
+        return f
+
+    def _make_run(self, x2x_cap: int):
         exp, pr, axis = self.exp, self.params, self.axis
         n_dev, h_local = self.n_dev, self.h_local
         window, model = self.window, self._model
@@ -159,11 +182,6 @@ class ShardedEngine:
             has_rx_qlen=gctx.has_rx_qlen, has_aqm=gctx.has_aqm,
         )
         jitter_vv = gctx.jitter_vv
-
-        # Per-(src→dst shard) bucket capacity: explicit knob or 2× the
-        # uniform-traffic expectation (N_local / n_dev), min 16.
-        n_local = h_local * pr.outbox_cap
-        x2x_cap = pr.x2x_cap or max(16, -(-2 * n_local // n_dev))
 
         def block(st: SimState, cols, n_windows) -> SimState:
             ctx = Ctx(
@@ -283,21 +301,43 @@ class ShardedEngine:
         if st is None:
             st = self.init_state()
         n = n_windows if n_windows is not None else self.n_windows
-        st = self._run_jit(st, jnp.asarray(n, jnp.int32))
-        if check_x2x:
+        base = int(st.metrics.x2x_overflow)
+        out = self._get_run(self._x2x_cap)(st, jnp.asarray(n, jnp.int32))
+        if not check_x2x:
+            return out
+        drops = int(out.metrics.x2x_overflow) - base
+        if (drops and not base and not self.params.x2x_cap
+                and self._x2x_cap < self._full_cap):
+            # Auto-sized cap overflowed (convergent traffic). The input
+            # state is immutable, so re-running it at the guaranteed-fit
+            # cap is exact — results bit-match a single-device run. The
+            # larger cap sticks for subsequent chunks of this engine.
+            import warnings
+
+            warnings.warn(
+                f"x2x bucket overflow ({drops} pkts) at auto cap "
+                f"{self._x2x_cap}; retrying at worst-case cap "
+                f"{self._full_cap} (one recompile) — set "
+                f"EngineParams.x2x_cap to pin the exchange size",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._x2x_cap = self._full_cap
+            out = self._get_run(self._x2x_cap)(st, jnp.asarray(n, jnp.int32))
+        total = int(out.metrics.x2x_overflow)
+        if total:
             # Loud failure beats silently-wrong results: a full all_to_all
             # bucket means packets vanished and single-device parity is
-            # gone. Re-run with a larger EngineParams.x2x_cap (or pass
-            # check_x2x=False to inspect the partial state).
-            drops = int(st.metrics.x2x_overflow)
-            if drops:
-                raise RuntimeError(
-                    f"{drops} packets dropped by full all_to_all buckets "
-                    f"(x2x_cap too small for this traffic pattern) — results "
-                    f"diverge from the single-device engine; raise "
-                    f"EngineParams.x2x_cap or pass check_x2x=False"
-                )
-        return st
+            # gone. Cumulative on purpose: a state carrying drops from an
+            # earlier check_x2x=False run (or a lossy checkpoint) is
+            # already divergent and must not pass a checked run silently.
+            raise RuntimeError(
+                f"{total} packets dropped by full all_to_all buckets "
+                f"(x2x_cap too small for this traffic pattern) — results "
+                f"diverge from the single-device engine; raise "
+                f"EngineParams.x2x_cap or pass check_x2x=False"
+            )
+        return out
 
     metrics_dict = staticmethod(Engine.metrics_dict)
 

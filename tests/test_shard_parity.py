@@ -85,6 +85,42 @@ def test_x2x_bucket_overflow_is_counted():
     ), tm
 
 
+def test_x2x_auto_retry_convergent_traffic():
+    """Convergent (all clients → one server) traffic overflows the uniform
+    auto cap by design; run() must escalate to the worst-case cap and
+    produce results bit-identical to the single-device engine — the exact
+    failure shape that broke the round-3 multichip gate."""
+    import __graft_entry__ as ge
+
+    # The gate's own flagship shape (4 hosts/shard), auto cap instead of
+    # the gate's pinned one so the escalation path is what runs.
+    exp = ge._flagship_exp(32, 1 * SEC)
+    params = EngineParams(ev_cap=64, outbox_cap=16, sockets_per_host=4)
+    assert params.x2x_cap == 0  # auto-sized: the path under test
+    sh = ShardedEngine(exp, params)
+    start_cap = sh._x2x_cap
+    st8 = sh.run(n_windows=4)
+    m8 = ShardedEngine.metrics_dict(st8)
+    assert m8["x2x_overflow"] == 0
+    # The workload converges on shard 0, so the retry must actually fire —
+    # otherwise this test is not exercising the escalation path.
+    assert sh._x2x_cap == sh._full_cap > start_cap
+    eng = Engine(exp, params)
+    st1 = eng.run(n_windows=4)
+    m1 = Engine.metrics_dict(st1)
+    for k in SEMANTIC_KEYS:
+        assert m8[k] == m1[k], (k, m8[k], m1[k])
+
+
+def test_dryrun_multichip_gate():
+    """Execute the driver's own multichip gate (__graft_entry__) so its exact
+    parameterization is covered by CI — round 3 shipped a gate-only failure
+    because nothing in tests/ ran this path."""
+    import __graft_entry__ as ge  # repo root is on pythonpath (pyproject)
+
+    ge.dryrun_multichip(8)
+
+
 def test_tor_sharded_parity():
     """The flagship multi-chip workload (rung 4 is sharded Tor): clients,
     weighted relays and dirauths spread across all 8 shards; every semantic
