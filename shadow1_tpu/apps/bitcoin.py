@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from shadow1_tpu.core.dense import set_col
 from shadow1_tpu.consts import (
     K_APP,
     N_ACCEPTED,
@@ -130,9 +131,10 @@ def _mark_seen(app, mask, txid, now):
     t_safe = jnp.where(mask, txid, 0)
     was = app["seen"][hh, t_safe]
     new = mask & ~was
-    tcol = jnp.where(new, t_safe, app["seen"].shape[1])
-    app["seen"] = app["seen"].at[hh, tcol].set(True, mode="drop")
-    app["seen_time"] = app["seen_time"].at[hh, tcol].set(now, mode="drop")
+    # Dense one-hot writes, not .at[] scatters (core/dense.py: XLA
+    # serializes dynamic-index scatters on TPU; this runs per gossip round).
+    app["seen"] = set_col(app["seen"], t_safe, True, new)
+    app["seen_time"] = set_col(app["seen_time"], t_safe, now, new)
     return app, new
 
 
@@ -154,9 +156,7 @@ def on_wakeup(st, ctx, ev, mask):
         peer = app["peers"][hh, jnp.minimum(j, k_max - 1)]
         sock = (1 + j).astype(jnp.int32)
         napp = dict(app)
-        napp["nbr_sock"] = napp["nbr_sock"].at[hh, jnp.where(conn, j, k_max)].set(
-            sock, mode="drop"
-        )
+        napp["nbr_sock"] = set_col(napp["nbr_sock"], j, sock, conn)
         st = st._replace(model=st.model._replace(app=napp))
         return T.tcp_connect(st, ctx, conn, sock, peer, zero, ev.time)
 
@@ -236,8 +236,7 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     # INV for an unknown tx → GETDATA back on the same conn.
     want = msg & (cmd == CMD_INV) & ~seen & ~req
     napp = dict(app)
-    tcol = jnp.where(want, t_safe, napp["req"].shape[1])
-    napp["req"] = napp["req"].at[hh, tcol].set(True, mode="drop")
+    napp["req"] = set_col(napp["req"], t_safe, True, want)
     st = st._replace(model=st.model._replace(app=napp))
 
     # GETDATA for a tx we hold → send the payload. The two responses are

@@ -61,6 +61,7 @@ from shadow1_tpu.consts import (
     TCP_FREE,
     TCP_LISTEN,
 )
+from shadow1_tpu.core.dense import add_col, set_col
 from shadow1_tpu.core.engine import push_local_event
 from shadow1_tpu.core.events import push_local
 from shadow1_tpu.consts import NP as NPCOLS
@@ -284,7 +285,6 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
     circ, aux, cmd = _decode(meta)
     app = dict(st.model.app)
     n_s = app["rc_peer"].shape[1]
-    ct = app["ct_used"].shape[1]
 
     # --- C_CREATE: allocate a table entry, reply CREATED on the same leg.
     cr = m & (cmd == C_CREATE)
@@ -293,12 +293,14 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
     slot = jnp.argmax(free, axis=1)
     ok = cr & has_free
     app["ct_overflow"] = app["ct_overflow"] + (cr & ~has_free).astype(jnp.int64)
-    sl = jnp.where(ok, slot, ct)
-    app["ct_used"] = app["ct_used"].at[hh, sl].set(True, mode="drop")
-    app["ct_in_sock"] = app["ct_in_sock"].at[hh, sl].set(sock, mode="drop")
-    app["ct_in_circ"] = app["ct_in_circ"].at[hh, sl].set(circ, mode="drop")
-    app["ct_out_sock"] = app["ct_out_sock"].at[hh, sl].set(-1, mode="drop")
-    app["ct_pend"] = app["ct_pend"].at[hh, sl].set(False, mode="drop")
+    # Dense one-hot writes, not .at[] scatters — XLA serializes dynamic-index
+    # scatters on TPU and this block runs in every relay cell round
+    # (core/dense.py; the round-2 scatter postmortem applies here too).
+    app["ct_used"] = set_col(app["ct_used"], slot, True, ok)
+    app["ct_in_sock"] = set_col(app["ct_in_sock"], slot, sock, ok)
+    app["ct_in_circ"] = set_col(app["ct_in_circ"], slot, circ, ok)
+    app["ct_out_sock"] = set_col(app["ct_out_sock"], slot, -1, ok)
+    app["ct_pend"] = set_col(app["ct_pend"], slot, False, ok)
     st = st._replace(model=st.model._replace(app=app))
     st = _push_cell(st, ctx, ok, sock, _meta(circ, 0, C_CREATED), CELL, now)
 
@@ -330,20 +332,16 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
     osock = jnp.where(has_reuse, r_sock, d_sock)
     oks = has_reuse | can_dial
     # allocate the out-circ id from the conn's counter
-    sx = jnp.where(oks, osock, n_s)
     ocirc = app["rc_next_circ"][hh, jnp.minimum(osock, n_s - 1)]
-    app["rc_next_circ"] = app["rc_next_circ"].at[hh, sx].add(1, mode="drop")
-    app["rc_peer"] = app["rc_peer"].at[hh, jnp.where(can_dial, d_sock, n_s)].set(
-        target, mode="drop"
-    )
-    ix = jnp.where(oks, idx, ct)
-    app["ct_out_sock"] = app["ct_out_sock"].at[hh, ix].set(osock, mode="drop")
-    app["ct_out_circ"] = app["ct_out_circ"].at[hh, ix].set(ocirc, mode="drop")
+    app["rc_next_circ"] = add_col(app["rc_next_circ"], osock, 1, oks)
+    app["rc_peer"] = set_col(app["rc_peer"], d_sock, target, can_dial)
+    app["ct_out_sock"] = set_col(app["ct_out_sock"], idx, osock, oks)
+    app["ct_out_circ"] = set_col(app["ct_out_circ"], idx, ocirc, oks)
     # CREATE goes out now if the conn is up, else when it establishes.
     conn_up = has_reuse & (
         st.model.tcp["st"][hh, jnp.minimum(osock, n_s - 1)] == TCP_ESTABLISHED
     )
-    app["ct_pend"] = app["ct_pend"].at[hh, ix].set(~conn_up, mode="drop")
+    app["ct_pend"] = set_col(app["ct_pend"], idx, ~conn_up, oks)
     st = st._replace(model=st.model._replace(app=app))
     st = _push_cell(st, ctx, conn_up, osock, _meta(ocirc, 0, C_CREATE), CELL, now)
     st = push_local_event(
@@ -452,14 +450,11 @@ def on_wakeup(st, ctx, ev, mask):
     def _op_drain(st):
         sock = ev.p[:, 1]
         app = dict(st.model.app)
-        ct = app["ct_used"].shape[1]
         pend = app["ct_used"] & app["ct_pend"] & (app["ct_out_sock"] == sock[:, None])
         has = drain & pend.any(axis=1)
         idx = jnp.argmax(pend, axis=1)
         ocirc = app["ct_out_circ"][hh, idx]
-        app["ct_pend"] = app["ct_pend"].at[hh, jnp.where(has, idx, ct)].set(
-            False, mode="drop"
-        )
+        app["ct_pend"] = set_col(app["ct_pend"], idx, False, has)
         more = drain & (pend.sum(axis=1) > 1)
         st = st._replace(model=st.model._replace(app=app))
         st = _push_cell(st, ctx, has, sock, _meta(ocirc, 0, C_CREATE), CELL, now)

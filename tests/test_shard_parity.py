@@ -9,6 +9,7 @@ inner round loop, so their sum legitimately differs from the global count.
 """
 
 import numpy as np
+import pytest
 
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
@@ -112,10 +113,13 @@ def test_x2x_auto_retry_convergent_traffic():
         assert m8[k] == m1[k], (k, m8[k], m1[k])
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_gate():
     """Execute the driver's own multichip gate (__graft_entry__) so its exact
     parameterization is covered by CI — round 3 shipped a gate-only failure
-    because nothing in tests/ ran this path."""
+    because nothing in tests/ ran this path. Slow tier: ~5 sharded-program
+    compiles; the fast tier keeps the auto-retry test above as the
+    regression guard."""
     import __graft_entry__ as ge  # repo root is on pythonpath (pyproject)
 
     ge.dryrun_multichip(8)
