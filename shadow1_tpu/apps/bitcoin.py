@@ -37,7 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from shadow1_tpu.core.dense import set_col
+from shadow1_tpu.core.dense import get_col, set_col
 from shadow1_tpu.consts import (
     K_APP,
     N_ACCEPTED,
@@ -67,40 +67,40 @@ def _meta(cmd, txid):
 
 def init(ctx, evbuf, tcpd):
     cfg = ctx.model_cfg
-    peers = jnp.asarray(cfg["peers"], jnp.int32)          # [H, K]
+    peers = jnp.asarray(cfg["peers"], jnp.int32).T        # [K, H] host-minor
     tx_origin = np.asarray(cfg["tx_origin"], np.int64)    # [T] (host-side)
     tx_time = np.asarray(cfg["tx_time"], np.int64)
     n_tx = len(tx_origin)
     assert n_tx <= TXID_MASK
-    h, k_max = peers.shape
+    k_max, h = peers.shape
     app = {
         "peers": peers,
         # Socket reaching neighbor j (outbound = 1+j at dial time; inbound
         # learned on N_ACCEPTED); -1 = no conn yet.
-        "nbr_sock": jnp.full((h, k_max), -1, jnp.int32),
-        "seen": jnp.zeros((h, n_tx), bool),
-        "req": jnp.zeros((h, n_tx), bool),
-        "seen_time": jnp.zeros((h, n_tx), jnp.int64),
+        "nbr_sock": jnp.full((k_max, h), -1, jnp.int32),
+        "seen": jnp.zeros((n_tx, h), bool),
+        "req": jnp.zeros((n_tx, h), bool),
+        "seen_time": jnp.zeros((n_tx, h), jnp.int64),
         "tx_rx": jnp.zeros(h, jnp.int64),   # tx payloads received
         "msg_retries": jnp.zeros(h, jnp.int64),
     }
     tcpd = dict(tcpd)
-    tcpd["st"] = tcpd["st"].at[:, 0].set(TCP_LISTEN)
+    tcpd["st"] = tcpd["st"].at[0].set(TCP_LISTEN)
     # Dial the conn mesh: one OP_CONNECT_ONE per outbound neighbor slot.
     connect_time = jnp.full(ctx.n_hosts, int(cfg.get("connect_time", 0)), jnp.int64)
     kk = jnp.full(ctx.n_hosts, K_APP, jnp.int32)
     n_over = jnp.zeros((), jnp.int64)
     for j in range(k_max):
-        m = peers[:, j] > ctx.hosts
-        p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
-        p = p.at[:, 0].set(OP_CONNECT_ONE).at[:, 1].set(j)
+        m = peers[j] > ctx.hosts
+        p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
+        p = p.at[0].set(OP_CONNECT_ONE).at[1].set(j)
         evbuf, over = push_local(evbuf, m, connect_time, kk, p)
         n_over = n_over + over.sum(dtype=jnp.int64)
     # Seed tx-creation wakeups, one masked push per transaction.
     for t in range(n_tx):
         mask = ctx.hosts == int(tx_origin[t])
-        p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
-        p = p.at[:, 0].set(OP_TX_CREATE).at[:, 1].set(t)
+        p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
+        p = p.at[0].set(OP_TX_CREATE).at[1].set(t)
         evbuf, over = push_local(
             evbuf, mask, jnp.full(ctx.n_hosts, int(tx_time[t]), jnp.int64), kk, p
         )
@@ -119,17 +119,16 @@ def _announce(st, ctx, mask, txid, skip_sock, now):
     """Queue one INV per live neighbor conn except ``skip_sock``."""
     inv_size = int(ctx.model_cfg.get("inv_size", 36))
     app = st.model.app
-    for j in range(app["peers"].shape[1]):
-        ns = app["nbr_sock"][:, j]
+    for j in range(app["peers"].shape[0]):
+        ns = app["nbr_sock"][j]
         m = mask & (ns >= 0) & (ns != skip_sock)
         st = _push_msg(st, ctx, m, ns, _meta(CMD_INV, txid), inv_size, now)
     return st
 
 
 def _mark_seen(app, mask, txid, now):
-    hh = jnp.arange(app["seen"].shape[0])
     t_safe = jnp.where(mask, txid, 0)
-    was = app["seen"][hh, t_safe]
+    was = get_col(app["seen"], t_safe)
     new = mask & ~was
     # Dense one-hot writes, not .at[] scatters (core/dense.py: XLA
     # serializes dynamic-index scatters on TPU; this runs per gossip round).
@@ -139,10 +138,9 @@ def _mark_seen(app, mask, txid, now):
 
 
 def on_wakeup(st, ctx, ev, mask):
-    op = ev.p[:, 0]
+    op = ev.p[0]
     app = st.model.app
-    k_max = app["peers"].shape[1]
-    hh = jnp.arange(ctx.n_hosts)
+    k_max = app["peers"].shape[0]
     zero = jnp.zeros(ctx.n_hosts, jnp.int32)
 
     # OP_CONNECT_ONE: dial neighbor slot j = p1 on socket 1+j. Startup-only
@@ -152,8 +150,8 @@ def on_wakeup(st, ctx, ev, mask):
 
     def _op_conn(st):
         app = st.model.app
-        j = jnp.where(conn, ev.p[:, 1], 0)
-        peer = app["peers"][hh, jnp.minimum(j, k_max - 1)]
+        j = jnp.where(conn, ev.p[1], 0)
+        peer = get_col(app["peers"], j)
         sock = (1 + j).astype(jnp.int32)
         napp = dict(app)
         napp["nbr_sock"] = set_col(napp["nbr_sock"], j, sock, conn)
@@ -167,7 +165,7 @@ def on_wakeup(st, ctx, ev, mask):
     create = mask & (op == OP_TX_CREATE)
 
     def _op_create(st):
-        txid = ev.p[:, 1]
+        txid = ev.p[1]
         app = dict(st.model.app)
         app, new = _mark_seen(app, create, txid, ev.time)
         st = st._replace(model=st.model._replace(app=app))
@@ -181,14 +179,14 @@ def on_wakeup(st, ctx, ev, mask):
     # next window start — a congested conn defers gossip instead of losing
     # its framing (same shape as tor.py's OP_TX_CELL).
     tx = mask & (op == OP_TX_MSG)
-    sock, meta, nbytes = ev.p[:, 1], ev.p[:, 2], ev.p[:, 3]
+    sock, meta, nbytes = ev.p[1], ev.p[2], ev.p[3]
     tcp = st.model.tcp
     sk = jnp.where(tx, sock, 0)
-    snd_una = tcp["snd_una"][hh, sk]
-    app_end = tcp["app_end"][hh, sk]
+    snd_una = get_col(tcp["snd_una"], sk)
+    app_end = get_col(tcp["app_end"], sk)
     buffered = (app_end - snd_una) - (snd_una == 0).astype(jnp.int32)
     fits = (ctx.params.sndbuf - buffered) >= nbytes
-    mq_ok = ~tcp["mq_valid"][hh, sk].all(axis=1)
+    mq_ok = ~get_col(tcp["mq_valid"], sk).all(axis=0)
     can = tx & fits & mq_ok
     retry = tx & ~can
     st, _acc = T.tcp_send(st, ctx, can, sock, nbytes, meta, ev.time)
@@ -204,7 +202,6 @@ def on_wakeup(st, ctx, ev, mask):
 def on_notify(st, ctx, nf: T.Notif, now, mask):
     f = nf.flags
     sock = nf.sock
-    hh = jnp.arange(ctx.n_hosts)
     tx_size = int(ctx.model_cfg.get("tx_size", 400))
     inv_size = int(ctx.model_cfg.get("inv_size", 36))
 
@@ -214,11 +211,11 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
 
     def _accepted(st):
         app = dict(st.model.app)
-        peer = st.model.tcp["peer_host"][hh, jnp.where(acc, sock, 0)]
-        for j in range(app["peers"].shape[1]):
-            m = acc & (app["peers"][:, j] == peer) & (app["nbr_sock"][:, j] < 0)
-            app["nbr_sock"] = app["nbr_sock"].at[:, j].set(
-                jnp.where(m, sock, app["nbr_sock"][:, j])
+        peer = get_col(st.model.tcp["peer_host"], jnp.where(acc, sock, 0))
+        for j in range(app["peers"].shape[0]):
+            m = acc & (app["peers"][j] == peer) & (app["nbr_sock"][j] < 0)
+            app["nbr_sock"] = app["nbr_sock"].at[j].set(
+                jnp.where(m, sock, app["nbr_sock"][j])
             )
         return st._replace(model=st.model._replace(app=app))
 
@@ -230,8 +227,8 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
     txid = nf.meta & TXID_MASK
     app = st.model.app
     t_safe = jnp.where(msg, txid, 0)
-    seen = app["seen"][hh, t_safe]
-    req = app["req"][hh, t_safe]
+    seen = get_col(app["seen"], t_safe)
+    req = get_col(app["req"], t_safe)
 
     # INV for an unknown tx → GETDATA back on the same conn.
     want = msg & (cmd == CMD_INV) & ~seen & ~req
@@ -259,12 +256,12 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
 
 
 def summary(app) -> dict:
-    seen = app["seen"]
+    seen = app["seen"]                        # [T, H] internally
     return {
-        "seen": seen,
-        "seen_time": app["seen_time"],
+        "seen": seen.T,                       # [H, T] — oracle orientation
+        "seen_time": app["seen_time"].T,
         "tx_rx": app["tx_rx"],
-        "reach": seen.sum(axis=0),            # nodes reached per tx
+        "reach": seen.sum(axis=1),            # nodes reached per tx
         "msg_retries": app["msg_retries"],
         "total_seen": seen.sum(),
         "total_tx_rx": app["tx_rx"].sum(),

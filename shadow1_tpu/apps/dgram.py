@@ -31,7 +31,7 @@ def init(ctx, evbuf, tcpd):
         "rx_bytes": jnp.zeros(ctx.n_hosts, jnp.int64),
     }
     sender = app["left"] > 0
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32).at[:, 0].set(OP_TICK)
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32).at[0].set(OP_TICK)
     k = jnp.full(ctx.n_hosts, K_APP, jnp.int32)
     evbuf, over = push_local(
         evbuf, sender, jnp.asarray(cfg["start_time"], jnp.int64), k, p
@@ -40,7 +40,7 @@ def init(ctx, evbuf, tcpd):
 
 
 def on_wakeup(st, ctx, ev, mask):
-    m = mask & (ev.p[:, 0] == OP_TICK)
+    m = mask & (ev.p[0] == OP_TICK)
     app = st.model.app
     send = m & (app["left"] > 0)
     zero = jnp.zeros(ctx.n_hosts, jnp.int32)

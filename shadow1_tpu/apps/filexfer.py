@@ -53,12 +53,12 @@ def init(ctx, evbuf, tcpd):
     }
     # Servers listen on socket 0 from t=0.
     tcpd = dict(tcpd)
-    tcpd["st"] = tcpd["st"].at[:, 0].set(
-        jnp.where(role == 0, TCP_LISTEN, tcpd["st"][:, 0])
+    tcpd["st"] = tcpd["st"].at[0].set(
+        jnp.where(role == 0, TCP_LISTEN, tcpd["st"][0])
     )
     # Clients wake up at their start time.
     is_client = role == 1
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32).at[:, 0].set(OP_START)
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32).at[0].set(OP_START)
     k = jnp.full(ctx.n_hosts, K_APP, jnp.int32)
     evbuf, over = push_local(
         evbuf, is_client, jnp.asarray(cfg["start_time"], jnp.int64), k, p
@@ -93,7 +93,7 @@ def _client_start(st, ctx, mask, now):
 
 
 def on_wakeup(st, ctx, ev, mask):
-    start = mask & (ev.p[:, 0] == OP_START)
+    start = mask & (ev.p[0] == OP_START)
     return _client_start(st, ctx, start, ev.time)
 
 

@@ -66,9 +66,9 @@ def init(ctx, evbuf, tcpd):
     }
     # Every host serves on socket 0.
     tcpd = dict(tcpd)
-    tcpd["st"] = tcpd["st"].at[:, 0].set(TCP_LISTEN)
+    tcpd["st"] = tcpd["st"].at[0].set(TCP_LISTEN)
     starts = (active == 1) & (app["streams_left"] > 0)
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32).at[:, 0].set(OP_START)
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32).at[0].set(OP_START)
     k = jnp.full(ctx.n_hosts, K_APP, jnp.int32)
     evbuf, over = push_local(
         evbuf, starts, jnp.asarray(cfg["start_time"], jnp.int64), k, p
@@ -116,7 +116,7 @@ def _client_pump(st, ctx, mask, now):
 
 
 def on_wakeup(st, ctx, ev, mask):
-    start = mask & (ev.p[:, 0] == OP_START)
+    start = mask & (ev.p[0] == OP_START)
     return _start_stream(st, ctx, start, ev.time)
 
 

@@ -61,7 +61,7 @@ from shadow1_tpu.consts import (
     TCP_FREE,
     TCP_LISTEN,
 )
-from shadow1_tpu.core.dense import add_col, set_col
+from shadow1_tpu.core.dense import add_col, first_true_idx, get_col, set_col
 from shadow1_tpu.core.engine import push_local_event
 from shadow1_tpu.core.events import push_local
 from shadow1_tpu.consts import NP as NPCOLS
@@ -173,25 +173,25 @@ def init(ctx, evbuf, tcpd):
         "bootstrap_time": jnp.zeros(h, jnp.int64),
         "done_time": jnp.zeros(h, jnp.int64),
         # relay link conns + circuit table
-        "rc_peer": jnp.full((h, s), -1, jnp.int32),
-        "rc_next_circ": jnp.ones((h, s), jnp.int32),
-        "ct_used": jnp.zeros((h, ct), bool),
-        "ct_in_sock": jnp.zeros((h, ct), jnp.int32),
-        "ct_in_circ": jnp.zeros((h, ct), jnp.int32),
-        "ct_out_sock": jnp.full((h, ct), -1, jnp.int32),
-        "ct_out_circ": jnp.zeros((h, ct), jnp.int32),
-        "ct_pend": jnp.zeros((h, ct), bool),
+        "rc_peer": jnp.full((s, h), -1, jnp.int32),
+        "rc_next_circ": jnp.ones((s, h), jnp.int32),
+        "ct_used": jnp.zeros((ct, h), bool),
+        "ct_in_sock": jnp.zeros((ct, h), jnp.int32),
+        "ct_in_circ": jnp.zeros((ct, h), jnp.int32),
+        "ct_out_sock": jnp.full((ct, h), -1, jnp.int32),
+        "ct_out_circ": jnp.zeros((ct, h), jnp.int32),
+        "ct_pend": jnp.zeros((ct, h), bool),
         "cells_fwd": jnp.zeros(h, jnp.int64),
         "ct_overflow": jnp.zeros(h, jnp.int64),
         "cell_retries": jnp.zeros(h, jnp.int64),
     }
     tcpd = dict(tcpd)
     listeners = (role == 0) | (role == 2)
-    tcpd["st"] = tcpd["st"].at[:, 0].set(
-        jnp.where(jnp.asarray(listeners), TCP_LISTEN, tcpd["st"][:, 0])
+    tcpd["st"] = tcpd["st"].at[0].set(
+        jnp.where(jnp.asarray(listeners), TCP_LISTEN, tcpd["st"][0])
     )
     starts = (role == 1) & (np.asarray(cfg["n_circuits"]) > 0)
-    p = jnp.zeros((h, NPCOLS), jnp.int32).at[:, 0].set(OP_START)
+    p = jnp.zeros((NPCOLS, h), jnp.int32).at[0].set(OP_START)
     kk = jnp.full(h, K_APP, jnp.int32)
     evbuf, over = push_local(
         evbuf, jnp.asarray(starts), jnp.asarray(cfg["start_time"], jnp.int64), kk, p
@@ -273,24 +273,20 @@ def _ct_find(app, sock, circ, side):
     {'in', 'out'}. Returns (found[H], idx[H])."""
     m = (
         app["ct_used"]
-        & (app[f"ct_{side}_sock"] == sock[:, None])
-        & (app[f"ct_{side}_circ"] == circ[:, None])
+        & (app[f"ct_{side}_sock"] == sock[None, :])
+        & (app[f"ct_{side}_circ"] == circ[None, :])
     )
-    return m.any(axis=1), jnp.argmax(m, axis=1).astype(jnp.int32)
+    return first_true_idx(m)
 
 
 def _relay_on_cell(st, ctx, m, sock, meta, now):
     """The relay cell machine: one cell per host per round."""
-    hh = jnp.arange(ctx.n_hosts)
     circ, aux, cmd = _decode(meta)
     app = dict(st.model.app)
-    n_s = app["rc_peer"].shape[1]
 
     # --- C_CREATE: allocate a table entry, reply CREATED on the same leg.
     cr = m & (cmd == C_CREATE)
-    free = ~app["ct_used"]
-    has_free = free.any(axis=1)
-    slot = jnp.argmax(free, axis=1)
+    has_free, slot = first_true_idx(~app["ct_used"])
     ok = cr & has_free
     app["ct_overflow"] = app["ct_overflow"] + (cr & ~has_free).astype(jnp.int64)
     # Dense one-hot writes, not .at[] scatters — XLA serializes dynamic-index
@@ -312,35 +308,33 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
     from_in = other & f_in
     from_out = other & ~f_in & f_out
     idx = jnp.where(from_in, i_in, jnp.where(from_out, i_out, 0))
-    out_sock0 = app["ct_out_sock"][hh, idx]
+    out_sock0 = get_col(app["ct_out_sock"], idx)
 
     # --- C_EXTEND from the in-side with no out leg yet: open/reuse the
     # onward conn and queue its CREATE.
     ext = from_in & (cmd == C_EXTEND) & (out_sock0 < 0)
     target = aux
     # reuse: first outbound conn already dialed to this relay
-    reuse_m = app["rc_peer"] == target[:, None]
-    has_reuse = ext & reuse_m.any(axis=1)
-    r_sock = jnp.argmax(reuse_m, axis=1).astype(jnp.int32)
+    reuse_m = app["rc_peer"] == target[None, :]
+    any_reuse, r_sock = first_true_idx(reuse_m)
+    has_reuse = ext & any_reuse
     # else: lowest FREE socket ≥ 1 (children take the top; see tcp.py)
     tcp_free = st.model.tcp["st"] == TCP_FREE
-    tcp_free = tcp_free.at[:, 0].set(False)
+    tcp_free = tcp_free.at[0].set(False)
     need_dial = ext & ~has_reuse
-    can_dial = need_dial & tcp_free.any(axis=1)
-    d_sock = jnp.argmax(tcp_free, axis=1).astype(jnp.int32)
+    any_free, d_sock = first_true_idx(tcp_free)
+    can_dial = need_dial & any_free
     app["ct_overflow"] = app["ct_overflow"] + (need_dial & ~can_dial).astype(jnp.int64)
     osock = jnp.where(has_reuse, r_sock, d_sock)
     oks = has_reuse | can_dial
     # allocate the out-circ id from the conn's counter
-    ocirc = app["rc_next_circ"][hh, jnp.minimum(osock, n_s - 1)]
+    ocirc = get_col(app["rc_next_circ"], osock)
     app["rc_next_circ"] = add_col(app["rc_next_circ"], osock, 1, oks)
     app["rc_peer"] = set_col(app["rc_peer"], d_sock, target, can_dial)
     app["ct_out_sock"] = set_col(app["ct_out_sock"], idx, osock, oks)
     app["ct_out_circ"] = set_col(app["ct_out_circ"], idx, ocirc, oks)
     # CREATE goes out now if the conn is up, else when it establishes.
-    conn_up = has_reuse & (
-        st.model.tcp["st"][hh, jnp.minimum(osock, n_s - 1)] == TCP_ESTABLISHED
-    )
+    conn_up = has_reuse & (get_col(st.model.tcp["st"], osock) == TCP_ESTABLISHED)
     app["ct_pend"] = set_col(app["ct_pend"], idx, ~conn_up, oks)
     st = st._replace(model=st.model._replace(app=app))
     st = _push_cell(st, ctx, conn_up, osock, _meta(ocirc, 0, C_CREATE), CELL, now)
@@ -351,8 +345,8 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
     # --- C_CREATED arriving on an out leg: translate to EXTENDED inward.
     app = st.model.app
     created = from_out & (cmd == C_CREATED)
-    in_sock = app["ct_in_sock"][hh, idx]
-    in_circ = app["ct_in_circ"][hh, idx]
+    in_sock = get_col(app["ct_in_sock"], idx)
+    in_circ = get_col(app["ct_in_circ"], idx)
     st = _push_cell(
         st, ctx, created, in_sock, _meta(in_circ, 0, C_EXTENDED), CELL, now
     )
@@ -367,8 +361,8 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
 
     # --- forwarding: everything else crosses the relay.
     app = st.model.app
-    out_sock = app["ct_out_sock"][hh, idx]
-    out_circ = app["ct_out_circ"][hh, idx]
+    out_sock = get_col(app["ct_out_sock"], idx)
+    out_circ = get_col(app["ct_out_circ"], idx)
     # EXTEND with an existing out leg telescopes onward (the next relay does
     # the extending); only the ext-handled case (fresh out leg this round)
     # must not also forward.
@@ -387,8 +381,7 @@ def _relay_on_cell(st, ctx, m, sock, meta, now):
 
 # -- event handlers --------------------------------------------------------
 def on_wakeup(st, ctx, ev, mask):
-    op = ev.p[:, 0]
-    hh = jnp.arange(ctx.n_hosts)
+    op = ev.p[0]
     now = ev.time
     zero = jnp.zeros(ctx.n_hosts, jnp.int32)
     t = tables(ctx.model_cfg)
@@ -416,14 +409,14 @@ def on_wakeup(st, ctx, ev, mask):
     # message must fit the send buffer and a boundary slot must be free;
     # otherwise retry at the next window start (deterministic backoff).
     tx = mask & (op == OP_TX_CELL)
-    sock, meta, nbytes = ev.p[:, 1], ev.p[:, 2], ev.p[:, 3]
+    sock, meta, nbytes = ev.p[1], ev.p[2], ev.p[3]
     tcp = st.model.tcp
     sk = jnp.where(tx, sock, 0)
-    snd_una = tcp["snd_una"][hh, sk]
-    app_end = tcp["app_end"][hh, sk]
+    snd_una = get_col(tcp["snd_una"], sk)
+    app_end = get_col(tcp["app_end"], sk)
     buffered = (app_end - snd_una) - (snd_una == 0).astype(jnp.int32)
     fits = (ctx.params.sndbuf - buffered) >= nbytes
-    mq_ok = ~tcp["mq_valid"][hh, sk].all(axis=1)
+    mq_ok = ~get_col(tcp["mq_valid"], sk).all(axis=0)
     can = tx & fits & mq_ok
     retry = tx & ~can
     st, _acc = T.tcp_send(st, ctx, can, sock, nbytes, meta, now)
@@ -439,7 +432,7 @@ def on_wakeup(st, ctx, ev, mask):
     dial = mask & (op == OP_CONNECT_RELAY)
     st = jax.lax.cond(
         dial.any(),
-        lambda s: T.tcp_connect(s, ctx, dial, ev.p[:, 1], ev.p[:, 2], zero, now),
+        lambda s: T.tcp_connect(s, ctx, dial, ev.p[1], ev.p[2], zero, now),
         lambda s: s, st,
     )
 
@@ -448,14 +441,14 @@ def on_wakeup(st, ctx, ev, mask):
     drain = mask & (op == OP_DRAIN)
 
     def _op_drain(st):
-        sock = ev.p[:, 1]
+        sock = ev.p[1]
         app = dict(st.model.app)
-        pend = app["ct_used"] & app["ct_pend"] & (app["ct_out_sock"] == sock[:, None])
-        has = drain & pend.any(axis=1)
-        idx = jnp.argmax(pend, axis=1)
-        ocirc = app["ct_out_circ"][hh, idx]
+        pend = app["ct_used"] & app["ct_pend"] & (app["ct_out_sock"] == sock[None, :])
+        any_p, idx = first_true_idx(pend)
+        has = drain & any_p
+        ocirc = get_col(app["ct_out_circ"], idx)
         app["ct_pend"] = set_col(app["ct_pend"], idx, False, has)
-        more = drain & (pend.sum(axis=1) > 1)
+        more = drain & (pend.sum(axis=0) > 1)
         st = st._replace(model=st.model._replace(app=app))
         st = _push_cell(st, ctx, has, sock, _meta(ocirc, 0, C_CREATE), CELL, now)
         return push_local_event(st, ctx, more, now, K_APP, p0=OP_DRAIN, p1=sock)
@@ -595,9 +588,7 @@ def on_notify(st, ctx, nf: T.Notif, now, mask):
 
     # Relay: onward conn established → drain pending CREATEs.
     app = st.model.app
-    hh = jnp.arange(ctx.n_hosts)
-    n_s = app["rc_peer"].shape[1]
-    dialed = app["rc_peer"][hh, jnp.minimum(sock, n_s - 1)] >= 0
+    dialed = get_col(app["rc_peer"], sock) >= 0
     r_est = mask & (role == 0) & est & dialed
     st = push_local_event(st, ctx, r_est, now, K_APP, p0=OP_DRAIN, p1=sock)
 

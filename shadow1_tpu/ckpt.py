@@ -14,6 +14,13 @@ from __future__ import annotations
 import numpy as np
 import jax
 
+# Snapshot format version. Bump whenever the SimState pytree's leaf order,
+# count, or layout changes so stale snapshots fail with a clear message
+# instead of an opaque shape/KeyError (round-3 advisor finding).
+#   1: round 2-3 host-major layout
+#   2: round 4 host-minor layout ([C,H]/[S,H]/[NP,C,H] tensors)
+CKPT_FORMAT = 2
+
 
 def _flatten(st):
     leaves, treedef = jax.tree_util.tree_flatten(st)
@@ -30,6 +37,7 @@ def save_state(st, path: str) -> None:
 
     leaves, _ = _flatten(st)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["format"] = np.asarray([CKPT_FORMAT, len(leaves)], np.int64)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
@@ -41,6 +49,18 @@ def load_state(template, path: str):
     ``engine.init_state()``) — shapes/dtypes must match the engine config."""
     tleaves, treedef = _flatten(template)
     with np.load(path) as data:
+        fmt = data["format"] if "format" in data.files else np.asarray([1, -1])
+        if int(fmt[0]) != CKPT_FORMAT:
+            raise ValueError(
+                f"checkpoint {path} has format v{int(fmt[0])}, this build "
+                f"reads v{CKPT_FORMAT} — snapshot from an incompatible "
+                f"framework version; re-run from scratch"
+            )
+        if int(fmt[1]) != len(tleaves):
+            raise ValueError(
+                f"checkpoint {path} holds {int(fmt[1])} state leaves, engine "
+                f"expects {len(tleaves)} — engine config mismatch"
+            )
         leaves = [data[f"leaf_{i}"] for i in range(len(tleaves))]
     for i, (have, want) in enumerate(zip(leaves, tleaves)):
         w = np.asarray(want)

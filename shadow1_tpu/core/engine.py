@@ -169,10 +169,10 @@ def push_local_event(st: SimState, ctx: Ctx, mask, time, kind,
     from shadow1_tpu.core.events import push_local
     from shadow1_tpu.consts import NP
 
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
     for i, pi in enumerate((p0, p1, p2, p3)):
         if pi is not None:
-            p = p.at[:, i].set(jnp.asarray(pi, jnp.int32))
+            p = p.at[i].set(jnp.asarray(pi, jnp.int32))
     k = jnp.full(ctx.n_hosts, kind, jnp.int32)
     evbuf, over = push_local(st.evbuf, mask, time, k, p)
     m = st.metrics
@@ -192,16 +192,18 @@ class FlatPackets(NamedTuple):
     """One window's routed packets, flattened to a single axis.
 
     ``dst`` is a GLOBAL host id; ``keep`` marks packets that survived the
-    loss draw. Under sharding, each shard produces its local FlatPackets and
-    the per-window all_gather over the mesh concatenates them (shard-major =
-    global host-major, the exact order the single-device engine uses).
+    loss draw. Flat order is slot-major over the [P, H] outbox — an
+    engine-internal layout detail: per-(src,dst) pair it equals send order,
+    and event pop order is decided by the (time, tb) keys alone, so results
+    are layout-independent. Under sharding the per-window all_to_all
+    concatenates received buckets in source-shard order.
     """
 
     dst: jnp.ndarray      # i32 [N] global dst host
     arrival: jnp.ndarray  # i64 [N]
     tb: jnp.ndarray       # i64 [N]
     kind: jnp.ndarray     # i32 [N]
-    p: jnp.ndarray        # i32 [N, NP]
+    p: jnp.ndarray        # i32 [NP, N]
     keep: jnp.ndarray     # bool [N]
 
 
@@ -283,12 +285,12 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.nd
     The tensor analogue of the reference's topology path lookup at send time
     (src/main/routing/topology.c getLatency/getReliability, SURVEY §3.3).
     Returns (flat_packets, n_sent, n_lost)."""
-    h, cap = ob.dst.shape
-    mask = jnp.arange(cap)[None, :] < ob.cnt[:, None]
-    src = jnp.broadcast_to(ctx.hosts[:, None], (h, cap))
+    cap, h = ob.dst.shape
+    mask = jnp.arange(cap)[:, None] < ob.cnt[None, :]
+    src = jnp.broadcast_to(ctx.hosts[None, :], (cap, h))
 
     def flat(x):
-        return x.reshape((h * cap,) + x.shape[2:])
+        return x.reshape(x.shape[:-2] + (cap * h,))
 
     fmask, fsrc, fdst = flat(mask), flat(src), flat(ob.dst)
     fdst_safe = jnp.where(fmask, fdst, 0)

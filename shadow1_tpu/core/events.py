@@ -3,9 +3,10 @@
 The reference gives every host a binary-heap event queue and a locked async
 queue for cross-thread pushes (src/main/core/scheduler/*,
 src/main/utility/priority-queue.c). Here all H queues live in one set of
-fixed-capacity SoA tensors ``[H, C]``; pop-min is a masked two-stage argmin,
-local push writes the first free slot, and cross-host delivery is a sorted
-batch merge performed once per conservative window (SURVEY §7.1).
+fixed-capacity SoA tensors ``[C, H]`` (slot-major, host-minor — see
+core/dense.py for why); pop-min is a pair of masked min-reductions, local
+push writes the first free slot, and cross-host delivery is a sorted batch
+merge performed once per conservative window (SURVEY §7.1).
 
 Total event order matches the reference's (time, host, seq) comparator
 (src/main/core/work/event.c): within a host, events pop by (time, tb) where
@@ -14,11 +15,14 @@ the host's own monotone counter, delivered packets use
 ``consts.packet_tb(src_host, src_pkt_counter)``. Both engines compute the
 same keys, so event order is engine-independent.
 
-TPU note: every update here is expressed densely (one-hot + where, or a
-sort + segment gather) — no dynamic-index scatters, which XLA serializes on
-TPU (see core/dense.py). The delivery merge is gather-style: each free slot
-computes which incoming packet it receives, rather than each packet
-scattering into a slot.
+TPU notes: every update is dense (one-hot + where, or a sort + segment
+gather) — no dynamic-index scatters, no per-slot ``argmin``/``cumsum`` in
+the round path (all measured slow on the chip; core/dense.py). Pop-min
+exploits that the (time, tb) key pair is UNIQUE per host — tb values never
+repeat within a host (local pushes consume a monotone counter; packet tbs
+embed the unique (src, src_ctr); the two ranges are disjoint via
+TB_PACKET_BASE) — so "the" minimum slot is an equality one-hot against the
+reduced (min-time, min-tb) pair, and payload extraction is a masked sum.
 """
 
 from __future__ import annotations
@@ -29,16 +33,16 @@ import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import K_NONE, NP
-from shadow1_tpu.core.dense import first_true, get_col, onehot_col
+from shadow1_tpu.core.dense import extract_col, first_true
 
 I64_MAX = jnp.iinfo(jnp.int64).max
 
 
 class EventBuf(NamedTuple):
-    time: jnp.ndarray      # i64 [H, C]
-    tb: jnp.ndarray        # i64 [H, C] tie-break key
-    kind: jnp.ndarray      # i32 [H, C] (K_NONE = free slot)
-    p: jnp.ndarray         # i32 [H, C, NP] payload columns
+    time: jnp.ndarray      # i64 [C, H]
+    tb: jnp.ndarray        # i64 [C, H] tie-break key
+    kind: jnp.ndarray      # i32 [C, H] (K_NONE = free slot)
+    p: jnp.ndarray         # i32 [NP, C, H] payload columns
     self_ctr: jnp.ndarray  # i64 [H] counter for locally-pushed tb keys
 
 
@@ -46,16 +50,16 @@ class Popped(NamedTuple):
     mask: jnp.ndarray   # bool [H] — host had an eligible event this round
     time: jnp.ndarray   # i64 [H]
     kind: jnp.ndarray   # i32 [H] (K_NONE where ~mask)
-    p: jnp.ndarray      # i32 [H, NP]
+    p: jnp.ndarray      # i32 [NP, H]
     tb: jnp.ndarray     # i64 [H] original tie-break (for cpu-model requeue)
 
 
 def evbuf_init(n_hosts: int, cap: int) -> EventBuf:
     return EventBuf(
-        time=jnp.full((n_hosts, cap), I64_MAX, jnp.int64),
-        tb=jnp.zeros((n_hosts, cap), jnp.int64),
-        kind=jnp.full((n_hosts, cap), K_NONE, jnp.int32),
-        p=jnp.zeros((n_hosts, cap, NP), jnp.int32),
+        time=jnp.full((cap, n_hosts), I64_MAX, jnp.int64),
+        tb=jnp.zeros((cap, n_hosts), jnp.int64),
+        kind=jnp.full((cap, n_hosts), K_NONE, jnp.int32),
+        p=jnp.zeros((NP, cap, n_hosts), jnp.int32),
         self_ctr=jnp.zeros(n_hosts, jnp.int64),
     )
 
@@ -68,12 +72,12 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
     """
     has_free, first = first_true(buf.kind == K_NONE)
     ok = mask & has_free
-    w = first & ok[:, None]
+    w = first & ok[None, :]
     buf = buf._replace(
-        time=jnp.where(w, jnp.asarray(time, jnp.int64)[..., None], buf.time),
-        tb=jnp.where(w, buf.self_ctr[:, None], buf.tb),
-        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[..., None], buf.kind),
-        p=jnp.where(w[..., None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
+        time=jnp.where(w, jnp.asarray(time, jnp.int64)[None, :], buf.time),
+        tb=jnp.where(w, buf.self_ctr[None, :], buf.tb),
+        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
+        p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
         self_ctr=buf.self_ctr + ok.astype(jnp.int64),
     )
     return buf, mask & ~has_free
@@ -88,33 +92,36 @@ def push_back(buf: EventBuf, mask, time, tb, kind, p) -> tuple[EventBuf, jnp.nda
     preserved. Does not advance self_ctr."""
     has_free, first = first_true(buf.kind == K_NONE)
     ok = mask & has_free
-    w = first & ok[:, None]
+    w = first & ok[None, :]
     buf = buf._replace(
-        time=jnp.where(w, jnp.asarray(time, jnp.int64)[..., None], buf.time),
-        tb=jnp.where(w, jnp.asarray(tb, jnp.int64)[..., None], buf.tb),
-        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[..., None], buf.kind),
-        p=jnp.where(w[..., None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
+        time=jnp.where(w, jnp.asarray(time, jnp.int64)[None, :], buf.time),
+        tb=jnp.where(w, jnp.asarray(tb, jnp.int64)[None, :], buf.tb),
+        kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
+        p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
     )
     return buf, mask & ~has_free
 
 
 def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
-    """Per-host pop of the minimum-(time, tb) event with time < until."""
+    """Per-host pop of the minimum-(time, tb) event with time < until.
+
+    Two min-reductions over the slot (sublane) axis + an equality one-hot;
+    exact because (time, tb) is unique per host (module docstring)."""
     elig = (buf.kind != K_NONE) & (buf.time < until)
     t_masked = jnp.where(elig, buf.time, I64_MAX)
-    min_t = t_masked.min(axis=1)
-    mask = elig.any(axis=1)
-    tie = elig & (t_masked == min_t[:, None])
+    min_t = t_masked.min(axis=0)
+    mask = elig.any(axis=0)
+    tie = elig & (t_masked == min_t[None, :])
     tb_masked = jnp.where(tie, buf.tb, I64_MAX)
-    slot = jnp.argmin(tb_masked, axis=1)
+    min_tb = tb_masked.min(axis=0)
+    sel = tie & (tb_masked == min_tb[None, :])      # one-hot per active host
     ev = Popped(
         mask=mask,
         time=jnp.where(mask, min_t, 0),
-        kind=jnp.where(mask, get_col(buf.kind, slot), K_NONE),
-        p=jnp.where(mask[:, None], get_col(buf.p, slot), 0),
-        tb=jnp.where(mask, get_col(buf.tb, slot), 0),
+        kind=extract_col(sel, buf.kind),
+        p=extract_col(sel, buf.p),
+        tb=jnp.where(mask, min_tb, 0),
     )
-    sel = onehot_col(slot, buf.time.shape[1], mask)
     buf = buf._replace(
         kind=jnp.where(sel, K_NONE, buf.kind),
         time=jnp.where(sel, I64_MAX, buf.time),
@@ -135,16 +142,20 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     host's r-th free slot *gathers* the r-th packet of its segment
     (seg_start[h] + r). All reads are sorted gathers; the only writes are
     dense ``where``s. Packet r per host is the r-th in flat source order,
-    and free slots fill in ascending slot index — identical order to the
-    reference's eager push. Returns (buf, n_overflow).
+    and free slots fill in ascending slot index. Slot ASSIGNMENT is an
+    engine-internal layout choice; pop order is decided purely by the
+    (time, tb) keys, so it is engine- and layout-independent.
+    Returns (buf, n_overflow). ``p`` is [NP, N].
 
     TPU tuning: the sort key packs (dst, flat index) into one integer so an
     *unstable* single-key sort is deterministic (keys are distinct and the
     packing preserves source order within a destination); segment bounds
-    come from one H+1-point searchsorted; the 15 payload columns (time/tb
-    split into i32 halves, kind, p) ride one stacked gather instead of four.
+    come from one H+1-point searchsorted; the 15 payload rows (time/tb
+    split into i32 halves, kind, p) ride one stacked gather instead of
+    four. This runs once per window, so its cumsum over the slot axis is
+    off the round path.
     """
-    n_hosts, cap = buf.time.shape
+    cap, n_hosts = buf.time.shape
     n = dst.shape[0]
     nb = max((n - 1).bit_length(), 1)
     wide = (n_hosts + 1) << nb > 2**31 - 1
@@ -154,33 +165,36 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     dst_s = (key_s >> nb).astype(jnp.int32)
     hs = jnp.arange(n_hosts + 1, dtype=jnp.int32)
     seg = jnp.searchsorted(dst_s, hs, side="left")
-    n_in = (seg[1:] - seg[:-1]).astype(jnp.int32)           # [H]
-    free = buf.kind == K_NONE                               # [H, C]
-    free_rank = (jnp.cumsum(free, axis=1) - free).astype(jnp.int32)
-    take = free & (free_rank < n_in[:, None])               # slot receives one
-    src = jnp.minimum(seg[:-1, None] + free_rank, n - 1)
-    oidx = (key_s & ((1 << nb) - 1)).astype(jnp.int32)[src]  # [H, C] flat idx
+    n_in = (seg[1:] - seg[:-1]).astype(jnp.int32)            # [H]
+    free = buf.kind == K_NONE                                # [C, H]
+    free_rank = (jnp.cumsum(free, axis=0) - free).astype(jnp.int32)
+    take = free & (free_rank < n_in[None, :])                # slot receives one
+    src = jnp.minimum(seg[:-1][None, :] + free_rank, n - 1)
+    oidx = (key_s & ((1 << nb) - 1)).astype(jnp.int32)[src]  # [C, H] flat idx
     stacked = jnp.concatenate(
-        [_lo(time), _hi(time), _lo(tb), _hi(tb), kind[:, None], p], axis=1
-    )                                                       # [N, 15] i32
-    g = stacked[oidx]                                       # [H, C, 15]
+        [
+            jnp.stack([_lo(time), _hi(time), _lo(tb), _hi(tb), kind]),
+            p,
+        ]
+    )                                                        # [5+NP, N] i32
+    g = stacked[:, oidx]                                     # [5+NP, C, H]
     buf = buf._replace(
-        time=jnp.where(take, _join(g[..., 0], g[..., 1]), buf.time),
-        tb=jnp.where(take, _join(g[..., 2], g[..., 3]), buf.tb),
-        kind=jnp.where(take, g[..., 4], buf.kind),
-        p=jnp.where(take[..., None], g[..., 5:], buf.p),
+        time=jnp.where(take, _join(g[0], g[1]), buf.time),
+        tb=jnp.where(take, _join(g[2], g[3]), buf.tb),
+        kind=jnp.where(take, g[4], buf.kind),
+        p=jnp.where(take[None], g[5:], buf.p),
     )
-    free_cnt = free.sum(axis=1, dtype=jnp.int32)
+    free_cnt = free.sum(axis=0, dtype=jnp.int32)
     n_over = mask.sum() - jnp.minimum(n_in, free_cnt).sum()
     return buf, n_over
 
 
 def _lo(x):
-    return (x & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32)[:, None]
+    return (x & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32)
 
 
 def _hi(x):
-    return ((x >> 32) & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32)[:, None]
+    return ((x >> 32) & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32)
 
 
 def _join(lo, hi):

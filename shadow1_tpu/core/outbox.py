@@ -7,6 +7,9 @@ cross-host event lands at least one window in the future, so the batched
 engine buffers all sends of a window here and performs routing (latency
 gather, loss draws) plus the destination scatter once per window — and, when
 sharded, exactly one all_to_all per window over ICI (SURVEY §2.5).
+
+Layout: slot-major, host-minor ([P, H]; payload [NP, P, H]) — see
+core/dense.py for the tiling rationale.
 """
 
 from __future__ import annotations
@@ -20,29 +23,29 @@ from shadow1_tpu.core.dense import set_col
 
 
 class Outbox(NamedTuple):
-    dst: jnp.ndarray      # i32 [H, P]
-    kind: jnp.ndarray     # i32 [H, P] event kind to deliver at dst
-    depart: jnp.ndarray   # i64 [H, P] time the packet leaves the src NIC
-    ctr: jnp.ndarray      # i64 [H, P] per-src lifetime packet counter
-    p: jnp.ndarray        # i32 [H, P, NP]
+    dst: jnp.ndarray      # i32 [P, H]
+    kind: jnp.ndarray     # i32 [P, H] event kind to deliver at dst
+    depart: jnp.ndarray   # i64 [P, H] time the packet leaves the src NIC
+    ctr: jnp.ndarray      # i64 [P, H] per-src lifetime packet counter
+    p: jnp.ndarray        # i32 [NP, P, H]
     cnt: jnp.ndarray      # i32 [H] entries used this window
     pkt_ctr: jnp.ndarray  # i64 [H] lifetime per-src packet counter
 
 
 def outbox_init(n_hosts: int, cap: int) -> Outbox:
     return Outbox(
-        dst=jnp.zeros((n_hosts, cap), jnp.int32),
-        kind=jnp.zeros((n_hosts, cap), jnp.int32),
-        depart=jnp.zeros((n_hosts, cap), jnp.int64),
-        ctr=jnp.zeros((n_hosts, cap), jnp.int64),
-        p=jnp.zeros((n_hosts, cap, NP), jnp.int32),
+        dst=jnp.zeros((cap, n_hosts), jnp.int32),
+        kind=jnp.zeros((cap, n_hosts), jnp.int32),
+        depart=jnp.zeros((cap, n_hosts), jnp.int64),
+        ctr=jnp.zeros((cap, n_hosts), jnp.int64),
+        p=jnp.zeros((NP, cap, n_hosts), jnp.int32),
         cnt=jnp.zeros(n_hosts, jnp.int32),
         pkt_ctr=jnp.zeros(n_hosts, jnp.int64),
     )
 
 
 def outbox_space(ob: Outbox) -> jnp.ndarray:
-    return ob.dst.shape[1] - ob.cnt
+    return ob.dst.shape[0] - ob.cnt
 
 
 def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.ndarray]:
@@ -50,9 +53,9 @@ def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.n
 
     Callers that cannot tolerate drops (TCP) must check ``outbox_space``
     first and defer to the next window instead (K_TX_RESUME). Dense one-hot
-    write — no scatter (core/dense.py).
+    write — no scatter (core/dense.py). ``p`` is [NP, H].
     """
-    cap = ob.dst.shape[1]
+    cap = ob.dst.shape[0]
     ok = mask & (ob.cnt < cap)
     ob = ob._replace(
         dst=set_col(ob.dst, ob.cnt, dst, ok),

@@ -29,7 +29,7 @@ class PholdState(NamedTuple):
 
 def init(ctx, evbuf: EventBuf):
     n = int(ctx.model_cfg.get("init_events", 1))
-    zero_p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+    zero_p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
     all_hosts = jnp.ones(ctx.n_hosts, bool)
     t0 = jnp.zeros(ctx.n_hosts, jnp.int64)
     k = jnp.full(ctx.n_hosts, K_PHOLD, jnp.int32)
@@ -56,7 +56,7 @@ def make_handlers(ctx):
         )
         dst = rng.randint(rng.bits_v(ctx.key, R_PHOLD_DST, hosts, model.ctr), ctx.n_total)
         t_next = ev.time + delay
-        zero_p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
+        zero_p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
         k = jnp.full(ctx.n_hosts, K_PHOLD, jnp.int32)
         local = m & (dst == hosts)
         evbuf, over = push_local(st.evbuf, local, t_next, k, zero_p)

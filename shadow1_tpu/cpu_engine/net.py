@@ -137,8 +137,11 @@ class CpuNetModel:
         self.has_rx_qlen = bool(np.asarray(eng.exp.rx_qlen_bytes).max() > 0)
         # Without an rx queue bound, NIC arrival processing is plumbing, not
         # an event: the engine run loop short-circuits K_PKT to rx_convert
-        # (mirror of net.make_pre_window's batched conversion).
-        self.rx_batch = not self.has_rx_qlen
+        # (mirror of net.make_pre_window's batched conversion). Virtual-CPU
+        # configs keep the per-event path so arrivals charge cpu time
+        # exactly as pre-round-3 semantics did (round-3 advisor finding).
+        self.rx_batch = not (self.has_rx_qlen
+                             or bool(np.asarray(eng.exp.cpu_ns_per_event).max() > 0))
         # RED AQM on the uplink (mirror of net/nic.py tx_stamp — identical
         # integer thresholds from the one shared table builder).
         self.aqm_min_ns, self.aqm_span_ns, self.aqm_pmax_thr = aqm_tables_np(

@@ -75,8 +75,9 @@ def tracker_records(engine, st) -> list[dict]:
 
     sim_ns = int(st.win_start)
     cols: dict[str, np.ndarray] = {}
+    # evbuf.kind is [ev_cap, H] (host-minor layout): reduce the slot axis.
     cols["pending_events"] = np.asarray(
-        (np.asarray(st.evbuf.kind) != 0).sum(axis=1)
+        (np.asarray(st.evbuf.kind) != 0).sum(axis=0)
     )
     cols["cpu_busy_ns"] = np.asarray(st.cpu_busy)
     # Model summaries own their key namespace (net exports nic_tx_bytes /

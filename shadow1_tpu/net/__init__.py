@@ -82,12 +82,12 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     The reference's UDP socket (src/main/host/descriptor/udp.c): no
     handshake, no reliability; loss/latency/bandwidth still apply.
     """
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
-    p = p.at[:, 0].set(ctx.hosts)
-    p = p.at[:, 1].set(T.pack_meta(0, dst_sock, F_DGRAM))
-    p = p.at[:, 4].set(jnp.asarray(length, jnp.int32))
-    p = p.at[:, 7].set(jnp.asarray(meta, jnp.int32))
-    p = p.at[:, 8].set(jnp.asarray(meta2, jnp.int32))
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
+    p = p.at[0].set(ctx.hosts)
+    p = p.at[1].set(T.pack_meta(0, dst_sock, F_DGRAM))
+    p = p.at[4].set(jnp.asarray(length, jnp.int32))
+    p = p.at[7].set(jnp.asarray(meta, jnp.int32))
+    p = p.at[8].set(jnp.asarray(meta2, jnp.int32))
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
@@ -127,22 +127,24 @@ def make_pre_window(ctx):
     path — the oracle mirrors this exactly, so parity is bit-identical).
 
     Returns None (keeping the per-round K_PKT handler) when the rx
-    drop-tail queue is configured: its drop decisions feed back into the
-    clock recurrence, which breaks the max-plus associativity."""
-    if ctx.has_rx_qlen:
+    drop-tail queue is configured (its drop decisions feed back into the
+    clock recurrence, which breaks the max-plus associativity) or when the
+    virtual-CPU model is on (arrival events must charge per-event cpu time
+    — round-3 advisor finding; the oracle mirrors both gates)."""
+    if ctx.has_rx_qlen or ctx.has_cpu:
         return None
     neg = -(1 << 62)
 
     def pre_window(st, _ctx, win_end):
         buf = st.evbuf
-        h, cap = buf.time.shape
+        cap, h = buf.time.shape
         sel = (buf.kind == K_PKT) & (buf.time < win_end)
         kind0, time0 = buf.kind, buf.time
         m = st.metrics
         if ctx.has_stop:
             # A stopped host discards arrivals unprocessed (run_round rule);
             # they must not reserve the downlink.
-            down = sel & (buf.time >= ctx.stop_time[:, None])
+            down = sel & (buf.time >= ctx.stop_time[None, :])
             sel = sel & ~down
             kind0 = jnp.where(down, K_NONE, kind0)
             time0 = jnp.where(down, I64_MAX, time0)
@@ -151,34 +153,34 @@ def make_pre_window(ctx):
         t_key = jnp.where(sel, buf.time, I64_MAX)
         tb_key = jnp.where(sel, buf.tb, I64_MAX)
         idx = jnp.broadcast_to(
-            jnp.arange(cap, dtype=jnp.int32)[None, :], (h, cap)
+            jnp.arange(cap, dtype=jnp.int32)[:, None], (cap, h)
         )
         t_s, _tb_s, idx_s = jax.lax.sort(
-            (t_key, tb_key, idx), dimension=-1, num_keys=2
+            (t_key, tb_key, idx), dimension=0, num_keys=2
         )
         valid = t_s < I64_MAX
-        plen = jnp.take_along_axis(buf.p[:, :, 4], idx_s, axis=1)
+        plen = jnp.take_along_axis(buf.p[4], idx_s, axis=0)
         wire = jnp.where(valid, plen.astype(jnp.int64) + WIRE_OVERHEAD, 0)
-        bw = ctx.bw_dn[:, None]
+        bw = ctx.bw_dn[None, :]
         ser = jnp.where(valid, (wire * (8 * SEC) + bw - 1) // bw, 0)
         # Max-plus prefix: each packet is the affine map x ↦ max(x+p, q)
         # with p = ser, q = arr + ser; invalid slots are the identity.
         pq = (ser, jnp.where(valid, t_s + ser, neg))
         p_pre, q_pre = jax.lax.associative_scan(
             lambda a, b: (a[0] + b[0], jnp.maximum(a[1] + b[0], b[1])),
-            pq, axis=1,
+            pq, axis=0,
         )
-        free0 = st.model.nic.rx_free[:, None]
+        free0 = st.model.nic.rx_free[None, :]
         free = jnp.maximum(free0 + p_pre, q_pre)      # clock after packet j
         ready = free - ser                            # = max(free_{j-1}, arr)
         # Un-sort: order by slot index restores original positions.
         _i, ready_o, valid_o = jax.lax.sort(
-            (idx_s, ready, valid.astype(jnp.int32)), dimension=-1, num_keys=1
+            (idx_s, ready, valid.astype(jnp.int32)), dimension=0, num_keys=1
         )
         vo = valid_o != 0
         nic = st.model.nic._replace(
-            rx_free=free[:, -1],
-            rx_bytes=st.model.nic.rx_bytes + wire.sum(axis=1),
+            rx_free=free[-1, :],
+            rx_bytes=st.model.nic.rx_bytes + wire.sum(axis=0),
         )
         evbuf = buf._replace(
             kind=jnp.where(vo, K_PKT_DELIVER, kind0),
@@ -200,7 +202,7 @@ def make_handlers(ctx):
         """K_PKT: packet reached the dst NIC — model the receive queue
         (drop-tail when the downlink queue bound is exceeded)."""
         m = ev.mask & (ev.kind == K_PKT)
-        wire = jnp.asarray(ev.p[:, 4], jnp.int64) + WIRE_OVERHEAD
+        wire = jnp.asarray(ev.p[4], jnp.int64) + WIRE_OVERHEAD
         nic, ready, okq = rx_stamp(
             st.model.nic, m, wire, ev.time, ctx.bw_dn,
             ctx.rx_qlen_ns if ctx.has_rx_qlen else None,
@@ -220,13 +222,13 @@ def make_handlers(ctx):
     def on_deliver(st, ev):
         """K_PKT_DELIVER: the packet cleared the NIC — run TCP/UDP, then app."""
         m = ev.mask & (ev.kind == K_PKT_DELIVER)
-        flags = (ev.p[:, 1] >> 16) & 0xFF
+        flags = (ev.p[1] >> 16) & 0xFF
         is_dgram = (flags & F_DGRAM) != 0
         st, nf = T.tcp_rx(st, ctx, m & ~is_dgram, ev.p, ev.time)
         dg = m & is_dgram
         nf = T._notify(
-            nf, dg, (ev.p[:, 1] >> 8) & 0xFF, N_DGRAM,
-            meta=ev.p[:, 7], meta2=ev.p[:, 8], dlen=ev.p[:, 4],
+            nf, dg, (ev.p[1] >> 8) & 0xFF, N_DGRAM,
+            meta=ev.p[7], meta2=ev.p[8], dlen=ev.p[4],
         )
         return app_on_notify(st, ctx, nf, ev.time, nf.flags != 0)
 
@@ -247,7 +249,7 @@ def make_handlers(ctx):
         K_TX_RESUME: on_txr,
         K_APP: on_app,
     }
-    if not ctx.has_rx_qlen:
+    if not (ctx.has_rx_qlen or ctx.has_cpu):
         # Arrivals are batch-converted by make_pre_window — no K_PKT event
         # ever reaches a round, so the pass (and its cond) would be dead.
         del handlers[K_PKT]

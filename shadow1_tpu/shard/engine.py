@@ -123,11 +123,13 @@ class ShardedEngine:
 
     # -- sharding specs ----------------------------------------------------
     def _spec_for(self, leaf) -> P:
-        # Every rank≥1 state tensor is host-major by design; scalars are
-        # replicated. (Guarded by the n_hosts match so aux leaves of other
-        # shapes would fail loudly in shard_map rather than mis-shard.)
-        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == self.exp.n_hosts:
-            return P(self.axis)
+        # Every rank≥1 state tensor is host-MINOR by design (the host axis
+        # is the last/lane axis — core/dense.py layout contract); scalars
+        # are replicated. (Guarded by the n_hosts match so aux leaves of
+        # other shapes would fail loudly in shard_map rather than mis-shard.)
+        if (hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[-1] == self.exp.n_hosts):
+            return P(*([None] * (leaf.ndim - 1)), self.axis)
         return P()
 
     def _state_specs(self, st: SimState):
@@ -237,11 +239,16 @@ class ShardedEngine:
                 )
                 stacked = jnp.concatenate(
                     [
-                        fp.dst[:, None],
-                        _lo(fp.arrival), _hi(fp.arrival),
-                        _lo(fp.tb), _hi(fp.tb),
-                        fp.kind[:, None],
-                        fp.p,
+                        jnp.stack(
+                            [
+                                fp.dst,
+                                _lo(fp.arrival), _hi(fp.arrival),
+                                _lo(fp.tb), _hi(fp.tb),
+                                fp.kind,
+                            ],
+                            axis=1,
+                        ),
+                        fp.p.T,
                     ],
                     axis=1,
                 )                                             # [N, 6+NP] i32
@@ -259,7 +266,7 @@ class ShardedEngine:
                     arrival=_join(r[:, 1], r[:, 2]),
                     tb=_join(r[:, 3], r[:, 4]),
                     kind=r[:, 5],
-                    p=r[:, 6:-1],
+                    p=r[:, 6:-1].T,
                     keep=keep,
                 )
                 return out, dropped

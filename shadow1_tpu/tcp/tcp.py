@@ -4,7 +4,8 @@ The tensor re-expression of the reference's biggest state machine
 (src/main/host/descriptor/tcp.c, SURVEY §2.3): 3-way handshake, sliding
 window, Reno-style congestion control (slow start, AIMD, fast retransmit on
 3 dup-ACKs, RTO with exponential backoff), RFC6298 integer RTT estimation,
-FIN teardown. State lives in a dict of ``[H, S]`` arrays; every operation
+FIN teardown. State lives in a dict of ``[S, H]`` arrays (socket-major,
+host-minor — core/dense.py layout contract); every operation
 is a masked gather/scatter over the (host, socket) plane — one packet per
 host per round, all hosts in parallel.
 
@@ -65,7 +66,14 @@ from shadow1_tpu.consts import (  # noqa: F811 — shared tuning/state sets
     TCP_RCV_STATES,
     TCP_SENDABLE_STATES,
 )
-from shadow1_tpu.core.dense import get_col, onehot_col, set_col
+from shadow1_tpu.core.dense import (
+    extract_col,
+    first_true_idx,
+    get_col,
+    last_true,
+    onehot_col,
+    set_col,
+)
 from shadow1_tpu.core.outbox import outbox_append, outbox_space
 from shadow1_tpu.net.nic import ctx_aqm, tx_stamp
 
@@ -83,26 +91,25 @@ _FIELDS_BOOL = ("timer_armed", "ts_act")
 def tcp_init(n_hosts: int, n_socks: int, mq_cap: int, params) -> dict:
     d = {}
     for f in _FIELDS_I32:
-        d[f] = jnp.zeros((n_hosts, n_socks), jnp.int32)
+        d[f] = jnp.zeros((n_socks, n_hosts), jnp.int32)
     for f in _FIELDS_I64:
-        d[f] = jnp.zeros((n_hosts, n_socks), jnp.int64)
+        d[f] = jnp.zeros((n_socks, n_hosts), jnp.int64)
     for f in _FIELDS_BOOL:
-        d[f] = jnp.zeros((n_hosts, n_socks), bool)
-    d["mq_valid"] = jnp.zeros((n_hosts, n_socks, mq_cap), bool)
-    d["mq_end"] = jnp.zeros((n_hosts, n_socks, mq_cap), jnp.int32)
-    d["mq_meta"] = jnp.zeros((n_hosts, n_socks, mq_cap), jnp.int32)
+        d[f] = jnp.zeros((n_socks, n_hosts), bool)
+    d["mq_valid"] = jnp.zeros((mq_cap, n_socks, n_hosts), bool)
+    d["mq_end"] = jnp.zeros((mq_cap, n_socks, n_hosts), jnp.int32)
+    d["mq_meta"] = jnp.zeros((mq_cap, n_socks, n_hosts), jnp.int32)
     return d
 
 
 class Sock:
     """Masked (host → socket) view over the TCP dict: readable sequential
     code, functional updates underneath. All reads/writes are [H] vectors at
-    [h, sock]; writes apply only where the (possibly narrowed) mask holds."""
+    [sock, h]; writes apply only where the (possibly narrowed) mask holds."""
 
     def __init__(self, tcp: dict, sock, mask):
         self.d = dict(tcp)
-        self.h = jnp.arange(tcp["st"].shape[0])
-        self.S = tcp["st"].shape[1]
+        self.S = tcp["st"].shape[0]
         self.sock = sock
         self.mask = mask
 
@@ -159,15 +166,15 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
 
     Caller must have established outbox space. Returns engine state.
     """
-    p = jnp.zeros((ctx.n_hosts, NP), jnp.int32)
-    p = p.at[:, 0].set(ctx.hosts)
-    p = p.at[:, 1].set(pack_meta(r.sock, r.g("peer_sock"), flags))
-    p = p.at[:, 2].set(seq)
-    p = p.at[:, 3].set(r.g("rcv_nxt"))
-    p = p.at[:, 4].set(jnp.asarray(length, jnp.int32))
-    p = p.at[:, 5].set(ctx.params.rcvbuf)
-    p = p.at[:, 6].set(mend)
-    p = p.at[:, 7].set(mmeta)
+    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
+    p = p.at[0].set(ctx.hosts)
+    p = p.at[1].set(pack_meta(r.sock, r.g("peer_sock"), flags))
+    p = p.at[2].set(seq)
+    p = p.at[3].set(r.g("rcv_nxt"))
+    p = p.at[4].set(jnp.asarray(length, jnp.int32))
+    p = p.at[5].set(ctx.params.rcvbuf)
+    p = p.at[6].set(mend)
+    p = p.at[7].set(mmeta)
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
@@ -242,7 +249,7 @@ def tcp_flush(st, ctx, mask, sock, now):
     rcv_nxt = g("rcv_nxt")
     peer_host, peer_sock = g("peer_host"), g("peer_sock")
     rto = g("rto")
-    mqv, mqe, mqm = g("mq_valid"), g("mq_end"), g("mq_meta")  # [H, MQ]
+    mqv, mqe, mqm = g("mq_valid"), g("mq_end"), g("mq_meta")  # [MQ, H]
     is_synrcvd = state == TCP_SYN_RCVD
 
     # --- burst recurrence: cheap per-lane arithmetic, heavy ops deferred ---
@@ -282,15 +289,18 @@ def tcp_flush(st, ctx, mask, sock, now):
         # see tcp_send): min mq end in (nxt, nxt+len].
         seg_hi = nxt + length
         inrange = (
-            mqv & ((mqe - nxt[:, None]) > 0) & ((mqe - seg_hi[:, None]) <= 0)
+            mqv & ((mqe - nxt[None, :]) > 0) & ((mqe - seg_hi[None, :]) <= 0)
         )
-        has_m = seg_data & inrange.any(axis=1)
-        dist = jnp.where(inrange, mqe - nxt[:, None], jnp.int32(2**31 - 1))
-        mi = jnp.argmin(dist, axis=1)
-        hh = jnp.arange(H)
-        mend = jnp.where(has_m, mqe[hh, mi], 0)
-        mmeta = jnp.where(has_m, mqm[hh, mi], 0)
-        length = jnp.where(has_m, dist[hh, mi], length)
+        has_m = seg_data & inrange.any(axis=0)
+        dist = jnp.where(inrange, mqe - nxt[None, :], jnp.int32(2**31 - 1))
+        # Nearest boundary via min-reduce + equality one-hot (no argmin —
+        # core/dense.py). Ends are distinct while valid, so `near` is
+        # one-hot among inrange slots.
+        dmin = dist.min(axis=0)
+        near = inrange & (dist == dmin[None, :])
+        mend = jnp.where(has_m, extract_col(near, mqe), 0)
+        mmeta = jnp.where(has_m, extract_col(near, mqm), 0)
+        length = jnp.where(has_m, dmin, length)
         # NIC uplink reservation per lane — tx_stamp itself (pure [H]-vector
         # arithmetic) threaded on a running NicState, so RED/drop-tail
         # semantics have exactly one source of truth (net/nic.py).
@@ -318,44 +328,44 @@ def tcp_flush(st, ctx, mask, sock, now):
 
     # --- one batched outbox append for the whole burst -------------------
     ob = st.outbox
-    cap = ob.dst.shape[1]
-    sent_l = jnp.stack([l[0] for l in lanes], axis=1)        # [H, B]
-    rank = jnp.cumsum(sent_l, axis=1) - sent_l.astype(jnp.int32)
-    pos = ob.cnt[:, None] + rank                              # [H, B]
+    cap = ob.dst.shape[0]
+    sent_l = jnp.stack([l[0] for l in lanes])                 # [B, H]
+    rank = jnp.cumsum(sent_l, axis=0) - sent_l.astype(jnp.int32)
+    pos = ob.cnt[None, :] + rank                              # [B, H]
     ok_l = sent_l & (pos < cap)
-    n_new = sent_l.sum(axis=1, dtype=jnp.int32)
+    n_new = sent_l.sum(axis=0, dtype=jnp.int32)
     slots = jnp.arange(cap, dtype=jnp.int32)[None, :, None]   # [1, P, 1]
-    sel = ok_l[:, None, :] & (pos[:, None, :] == slots)       # [H, P, B]
+    sel = ok_l[:, None, :] & (pos[:, None, :] == slots)       # [B, P, H]
+    written = sel.any(axis=0)                                 # [P, H]
 
     def merge(old, lane_vals, dt):
-        lv = jnp.stack(lane_vals, axis=1).astype(dt)          # [H, B, ...]
+        lv = jnp.stack(lane_vals).astype(dt)                  # [B, (NP,) H]
         if lv.ndim == 2:
-            new = (sel * lv[:, None, :].astype(dt)).sum(axis=2, dtype=dt)
-            return jnp.where(sel.any(axis=2), new, old)
-        # payload [H, B, NP]
-        s4 = sel[:, :, :, None]
-        new = (s4 * lv[:, None, :, :]).sum(axis=2, dtype=dt)
-        return jnp.where(sel.any(axis=2)[:, :, None], new, old)
+            new = (sel * lv[:, None, :]).sum(axis=0, dtype=dt)
+            return jnp.where(written, new, old)
+        # payload lanes [B, NP, H] -> [NP, P, H]
+        new = (sel[:, None, :, :] * lv[:, :, None, :]).sum(axis=0, dtype=dt)
+        return jnp.where(written[None], new, old)
 
     dstL = [jnp.where(l[0], peer_host, 0) for l in lanes]
     departL = [l[1] for l in lanes]
-    ctrL = [ob.pkt_ctr + rank[:, i].astype(jnp.int64) for i in range(B)]
+    ctrL = [ob.pkt_ctr + rank[i].astype(jnp.int64) for i in range(B)]
     pL = []
     p1 = pack_meta(sock, peer_sock, 0)
     for (snt, dep, seq, length, flags, mend, mmeta) in lanes:
-        p = jnp.zeros((H, NP), jnp.int32)
-        p = p.at[:, 0].set(ctx.hosts)
-        p = p.at[:, 1].set(p1 | (flags << 16))
-        p = p.at[:, 2].set(seq)
-        p = p.at[:, 3].set(rcv_nxt)
-        p = p.at[:, 4].set(length)
-        p = p.at[:, 5].set(pr.rcvbuf)
-        p = p.at[:, 6].set(mend)
-        p = p.at[:, 7].set(mmeta)
+        p = jnp.zeros((NP, H), jnp.int32)
+        p = p.at[0].set(ctx.hosts)
+        p = p.at[1].set(p1 | (flags << 16))
+        p = p.at[2].set(seq)
+        p = p.at[3].set(rcv_nxt)
+        p = p.at[4].set(length)
+        p = p.at[5].set(pr.rcvbuf)
+        p = p.at[6].set(mend)
+        p = p.at[7].set(mmeta)
         pL.append(p)
     ob = ob._replace(
         dst=merge(ob.dst, dstL, jnp.int32),
-        kind=jnp.where(sel.any(axis=2), K_PKT, ob.kind),
+        kind=jnp.where(written, K_PKT, ob.kind),
         depart=merge(ob.depart, departL, jnp.int64),
         ctr=merge(ob.ctr, ctrL, jnp.int64),
         p=merge(ob.p, pL, jnp.int32),
@@ -447,7 +457,7 @@ def _init_conn(r: Sock, ctx, mask, peer_host, peer_sock, state, rcv_nxt):
     r.s("recover", 0, mask)
     r.s("ts_act", False, mask)
     r.s("txr", 0, mask)
-    mq = jnp.where(mask[:, None], False, r.g("mq_valid"))
+    mq = jnp.where(mask[None, :], False, r.g("mq_valid"))
     r.s("mq_valid", mq, mask)
 
 
@@ -473,19 +483,18 @@ def tcp_send(st, ctx, mask, sock, nbytes, meta, now):
     r.s("app_end", new_end, accepted > 0)
     # Message boundary bookkeeping.
     want_meta = mask & (accepted > 0) & (accepted == nbytes) & (jnp.asarray(meta, jnp.int32) != 0)
-    mqv = r.g("mq_valid")
-    has_free = ~mqv.all(axis=1)
-    slot = jnp.argmin(mqv, axis=1)
+    mqv = r.g("mq_valid")                       # [MQ, H]
+    has_free, slot = first_true_idx(~mqv)
     ok = want_meta & has_free
-    # Dense (h, sock, slot) one-hot write — no 3D scatter (core/dense.py).
+    # Dense (slot, sock, host) one-hot write — no 3D scatter (core/dense.py).
     sel = (
-        onehot_col(r.sock, r.S, ok)[:, :, None]
-        & onehot_col(slot, mqv.shape[1])[:, None, :]
+        onehot_col(slot, mqv.shape[0])[:, None, :]
+        & onehot_col(r.sock, r.S, ok)[None, :, :]
     )
     r.d["mq_valid"] = r.d["mq_valid"] | sel
-    r.d["mq_end"] = jnp.where(sel, new_end[:, None, None], r.d["mq_end"])
+    r.d["mq_end"] = jnp.where(sel, new_end[None, None, :], r.d["mq_end"])
     r.d["mq_meta"] = jnp.where(
-        sel, jnp.asarray(meta, jnp.int32)[:, None, None], r.d["mq_meta"]
+        sel, jnp.asarray(meta, jnp.int32)[None, None, :], r.d["mq_meta"]
     )
     st = st._replace(model=st.model._replace(tcp=r.d))
     st = tcp_flush(st, ctx, mask & (accepted > 0), sock, now)
@@ -521,13 +530,13 @@ def tcp_rx(st, ctx, mask, p, now):
     """
     pr = ctx.params
     H = ctx.n_hosts
-    src = p[:, 0]
-    packed = p[:, 1]
+    src = p[0]
+    packed = p[1]
     ss = packed & 0xFF
     ds = (packed >> 8) & 0xFF
     flags = (packed >> 16) & 0xFF
-    seq, ackno, length = p[:, 2], p[:, 3], p[:, 4]
-    wnd, mend, mmeta = p[:, 5], p[:, 6], p[:, 7]
+    seq, ackno, length = p[2], p[3], p[4]
+    wnd, mend, mmeta = p[5], p[6], p[7]
     is_syn = (flags & F_SYN) != 0
     is_ack = (flags & F_ACK) != 0
     is_fin = (flags & F_FIN) != 0
@@ -544,19 +553,18 @@ def tcp_rx(st, ctx, mask, p, now):
     def _accept(st):
         tcp = st.model.tcp
         dup = (
-            (tcp["peer_host"] == src[:, None])
-            & (tcp["peer_sock"] == ss[:, None])
+            (tcp["peer_host"] == src[None, :])
+            & (tcp["peer_sock"] == ss[None, :])
             & (tcp["st"] != TCP_FREE)
             & (tcp["st"] != TCP_LISTEN)
-        ).any(axis=1)
+        ).any(axis=0)
         free = tcp["st"] == TCP_FREE
         # Children take the HIGHEST free slot: low slots are app-owned (0 =
         # listener, 1 = client socket on dual-role hosts) and may be
         # TCP_FREE between uses — allocating from the top keeps them
-        # unclobbered.
-        n_s = free.shape[1]
-        child = (n_s - 1 - jnp.argmax(free[:, ::-1], axis=1)).astype(jnp.int32)
-        new_conn = syn_to_listen & ~dup & free.any(axis=1)
+        # unclobbered. Max-reduce, not argmax (core/dense.py).
+        new_conn0, child = last_true(free)
+        new_conn = syn_to_listen & ~dup & new_conn0
         rc = Sock(tcp, child, new_conn)
         _init_conn(rc, ctx, new_conn, src, ss, TCP_SYN_RCVD, 1)
         rc.s("peer_wnd", wnd, new_conn)
@@ -607,7 +615,7 @@ def tcp_rx(st, ctx, mask, p, now):
     r.s("snd_una", ackno, new_ack)
     r.s("dupacks", 0, new_ack)
     # Retire message boundaries the peer has fully acked.
-    keep = r.g("mq_valid") & ((r.g("mq_end") - ackno[:, None]) > 0)
+    keep = r.g("mq_valid") & ((r.g("mq_end") - ackno[None, :]) > 0)
     r.s("mq_valid", keep, new_ack)
     # Restart (or clear) the retransmit deadline.
     outstanding = (snd_nxt - ackno) > 0
@@ -714,7 +722,7 @@ def on_tcp_timer(st, ctx, ev):
     """
     pr = ctx.params
     m = ev.mask & (ev.kind == K_TCP_TIMER)
-    sock = ev.p[:, 0]
+    sock = ev.p[0]
     now = ev.time
     r = Sock(st.model.tcp, sock, m)
     r.s("timer_armed", False, m)
@@ -751,7 +759,7 @@ def on_tcp_timer(st, ctx, ev):
 def on_tx_resume(st, ctx, ev):
     """K_TX_RESUME: continue a burst- or outbox-bounded flush."""
     m = ev.mask & (ev.kind == K_TX_RESUME)
-    sock = ev.p[:, 0]
+    sock = ev.p[0]
     r = Sock(st.model.tcp, sock, m)
     r.s("txr", 0, m)
     st = st._replace(model=st.model._replace(tcp=r.d))

@@ -1,4 +1,4 @@
-"""Aux subsystems: pipes, DNS registry, pcap capture, logger, tools."""
+"""Aux subsystems: DNS registry, pcap capture, logger, tools."""
 
 import json
 
@@ -8,42 +8,6 @@ import pytest
 
 from shadow1_tpu.config.experiment import build_experiment
 from shadow1_tpu.consts import MS, SEC
-from shadow1_tpu.net.pipe import pipe_init, pipe_read, pipe_readable, pipe_write
-
-
-def test_pipe_fifo_and_capacity():
-    h = 4
-    pt = pipe_init(h, n_pipes=2, mq_cap=2)
-    allh = jnp.ones(h, bool)
-    p0 = jnp.zeros(h, jnp.int32)
-    # two writes FIFO
-    pt, ok1 = pipe_write(pt, allh, p0, jnp.full(h, 10, jnp.int32),
-                         jnp.full(h, 111, jnp.int32), capacity=64)
-    pt, ok2 = pipe_write(pt, allh, p0, jnp.full(h, 20, jnp.int32),
-                         jnp.full(h, 222, jnp.int32), capacity=64)
-    assert bool(ok1.all()) and bool(ok2.all())
-    assert bool(pipe_readable(pt, p0).all())
-    # mq full (cap 2): third write refused
-    pt, ok3 = pipe_write(pt, allh, p0, jnp.full(h, 5, jnp.int32),
-                         jnp.full(h, 333, jnp.int32), capacity=64)
-    assert not bool(ok3.any())
-    # reads come back in write order — including after slot reuse
-    pt, got, n, m = pipe_read(pt, allh, p0)
-    assert bool(got.all()) and int(n[0]) == 10 and int(m[0]) == 111
-    pt, ok4 = pipe_write(pt, allh, p0, jnp.full(h, 30, jnp.int32),
-                         jnp.full(h, 444, jnp.int32), capacity=64)
-    assert bool(ok4.all())
-    pt, got, n, m = pipe_read(pt, allh, p0)
-    assert int(n[0]) == 20 and int(m[0]) == 222  # FIFO survives slot reuse
-    pt, got, n, m = pipe_read(pt, allh, p0)
-    assert int(n[0]) == 30 and int(m[0]) == 444
-    pt, got, n, m = pipe_read(pt, allh, p0)
-    assert not bool(got.any())
-    # byte-capacity refusal
-    pt, okbig = pipe_write(pt, allh, p0, jnp.full(h, 100, jnp.int32),
-                           jnp.full(h, 1, jnp.int32), capacity=64)
-    assert not bool(okbig.any())
-    assert int(pt.written[0, 0]) == 60 and int(pt.drained[0, 0]) == 60
 
 
 def _doc():

@@ -11,7 +11,7 @@ from shadow1_tpu.core.events import (
     push_local,
 )
 
-ZP = lambda h: jnp.zeros((h, NP), jnp.int32)
+ZP = lambda h: jnp.zeros((NP, h), jnp.int32)
 
 
 def test_push_pop_order():
@@ -59,11 +59,11 @@ def test_deliver_batch_ranks_and_overflow():
     time = jnp.array([10, 20, 30, 40, 50], jnp.int64)
     tb = jnp.arange(n, dtype=jnp.int64) + (1 << 62)
     kind = jnp.full(n, K_PHOLD, jnp.int32)
-    p = jnp.zeros((n, NP), jnp.int32)
+    p = jnp.zeros((NP, n), jnp.int32)
     mask = jnp.ones(n, bool)
     buf, n_over = deliver_batch(buf, dst, time, tb, kind, p, mask)
     assert int(n_over) == 1
-    counts = np.asarray((buf.kind != 0).sum(axis=1))
+    counts = np.asarray((buf.kind != 0).sum(axis=0))
     assert counts.tolist() == [1, 2, 1]
     # Host 1 keeps its two earliest-listed packets (rank order), pops in time order.
     buf, ev = pop_until(buf, jnp.int64(10**9))
