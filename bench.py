@@ -21,8 +21,10 @@ Robustness contract (round-1/2 postmortems):
   monolithic 2000-window XLA program; 50-window programs complete in
   seconds on this chip).
 * On a runtime fault the run retries at half scale, and finally on the
-  forced-CPU platform — a measurement is always produced and ``backend``
-  labels it honestly; compile time is reported separately from timed walls.
+  forced-CPU platform — a measurement is always produced; compile time is
+  reported separately from timed walls. A run that lands on the CPU
+  fallback emits ``value: null`` + ``invalid`` in the headline fields (the
+  fallback numbers stay under ``detail``): a CPU wall is not a TPU datum.
 
 The Python oracle is measured on a smaller host count (the eager oracle is
 O(events) Python; PHOLD cost/event is scale-stable) — see
@@ -252,11 +254,20 @@ def main() -> None:
         else:
             base_eps = cpu["events_per_sec"]
             base_kind = "python_oracle"
+        # A TPU benchmark that landed on the CPU fallback is NOT a perf
+        # datum: the headline fields must not publish a number whose
+        # denominator is a different machine class. The measured fallback
+        # row stays in detail for debugging.
+        on_accel = tpu.get("backend") not in ("", None, "cpu")
         result = {
             "metric": "phold_events_per_sec",
-            "value": round(tpu["events_per_sec"], 1),
+            "value": round(tpu["events_per_sec"], 1) if on_accel else None,
             "unit": "events/s",
-            "vs_baseline": round(tpu["events_per_sec"] / base_eps, 3),
+            "vs_baseline": (
+                round(tpu["events_per_sec"] / base_eps, 3) if on_accel else None
+            ),
+            **({} if on_accel else {"invalid": "no accelerator: run fell back "
+                                               "to the cpu backend"}),
             "detail": {
                 **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in tpu.items()},
                 "baseline_kind": base_kind,

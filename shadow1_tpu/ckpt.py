@@ -19,7 +19,8 @@ import jax
 # instead of an opaque shape/KeyError (round-3 advisor finding).
 #   1: round 2-3 host-major layout
 #   2: round 4 host-minor layout ([C,H]/[S,H]/[NP,C,H] tensors)
-CKPT_FORMAT = 2
+#   3: round 5 adds Metrics.x2x_max_fill (exchange occupancy high-water)
+CKPT_FORMAT = 3
 
 
 def _flatten(st):

@@ -15,6 +15,50 @@ import sys
 import time
 
 
+MAX_RESPAWNS = 8
+
+
+def _supervise(child_argv, ckpt_path) -> int:
+    """Parent side of ``--ckpt`` fault tolerance (the ladder's recipe,
+    bench_ladder.py): run the CLI in a child process; when it dies with a
+    checkpoint showing forward progress, respawn a fresh child that resumes
+    from the snapshot — a wedged-runtime fault never survives into the next
+    attempt because the next attempt is a new process."""
+    import os
+    import subprocess
+
+    sidecar = ckpt_path + ".progress"
+    last_progress = -1
+    rc = 1
+    for attempt in range(MAX_RESPAWNS + 1):
+        cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
+               "--supervised-child"]
+        rc = subprocess.run(cmd).returncode  # stdio inherited: heartbeats flow
+        if rc == 0:
+            # A finished run's snapshot must not silently resume a later
+            # invocation of the same command into a no-op.
+            for p in (ckpt_path, sidecar):
+                if os.path.exists(p):
+                    os.remove(p)
+            return 0
+        progress = -1
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar) as f:
+                    progress = json.load(f).get("win_start", -1)
+            except (OSError, ValueError):
+                progress = -1
+        if progress <= last_progress or attempt == MAX_RESPAWNS:
+            # Failure before the first checkpoint, or a whole process with
+            # no forward progress: a respawn would just repeat it.
+            return rc
+        last_progress = progress
+        print(f"[supervise] child died rc={rc} at sim_ns={progress}; "
+              f"respawning ({attempt + 1}/{MAX_RESPAWNS})",
+              file=sys.stderr, flush=True)
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="shadow1_tpu",
@@ -31,6 +75,19 @@ def main(argv=None) -> int:
                     help="emit a heartbeat line to stderr every W windows")
     ap.add_argument("--save-state", default=None, metavar="PATH",
                     help="snapshot final engine state to PATH (.npz)")
+    ap.add_argument("--ckpt", default=None, metavar="PATH",
+                    help="fault-tolerant run: snapshot state to PATH at "
+                         "heartbeat boundaries and supervise the run in a "
+                         "child process — on a device fault the child is "
+                         "respawned resuming from PATH (the ladder's "
+                         "chunk+resume recipe; tunneled TPUs wedge whole "
+                         "processes)")
+    ap.add_argument("--ckpt-every-s", type=float, default=120.0,
+                    metavar="S", help="throttle --ckpt snapshots to ~S "
+                                      "seconds of wall (saves cost host "
+                                      "transfer + npz write)")
+    ap.add_argument("--supervised-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--resume", default=None, metavar="PATH",
                     help="resume from a state snapshot (batched engines)")
     ap.add_argument("--tracker", default=None, metavar="PATH",
@@ -49,6 +106,22 @@ def main(argv=None) -> int:
 
     exp, params, scheduler = load_experiment(args.config)
     engine_kind = args.engine or scheduler
+    if engine_kind == "cpu" and (args.save_state or args.resume
+                                 or args.heartbeat or args.tracker
+                                 or args.profile or args.ckpt):
+        ap.error("--save-state/--resume/--heartbeat/--tracker/--profile/"
+                 "--ckpt require a batched engine (tpu or sharded)")
+    if args.ckpt and args.resume and args.windows is not None:
+        # Under supervision --windows is the TOTAL for the whole run; under
+        # --resume it means N MORE windows. Combining all three makes a
+        # respawned child's remaining-window arithmetic ambiguous — refuse.
+        ap.error("--ckpt with both --resume and --windows is ambiguous "
+                 "(total or N-more?); drop one of them")
+    if args.ckpt and not args.supervised_child:
+        # Parent side of fault tolerance: never init the accelerator here —
+        # all device work happens in supervised children.
+        return _supervise(argv if argv is not None else sys.argv[1:],
+                          args.ckpt)
     # Survive a dead/hanging accelerator backend. The CPU oracle needs jax
     # too (it mirrors the RNG streams), but never an accelerator — force
     # CPU directly and skip the probe cost.
@@ -58,11 +131,6 @@ def main(argv=None) -> int:
         force_cpu(1)
     else:
         ensure_live_platform(min_devices=1)
-    if engine_kind == "cpu" and (args.save_state or args.resume
-                                 or args.heartbeat or args.tracker
-                                 or args.profile):
-        ap.error("--save-state/--resume/--heartbeat/--tracker/--profile "
-                 "require a batched engine (tpu or sharded)")
     from shadow1_tpu.log import SimLogger
 
     log = SimLogger(level=args.log_level)
@@ -70,6 +138,7 @@ def main(argv=None) -> int:
              window_ns=exp.window)
     t0 = time.perf_counter()
     metrics0: dict[str, int] = {}
+    resume_path = None
 
     if engine_kind == "cpu":
         from shadow1_tpu.cpu_engine import CpuEngine
@@ -87,26 +156,41 @@ def main(argv=None) -> int:
             from shadow1_tpu.core.engine import Engine as Eng
         eng = Eng(exp, params)
         st = None
-        if args.resume:
+        # A --ckpt snapshot on disk wins over --resume: it is the newer
+        # state a supervised respawn must continue from.
+        import os
+
+        resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
+                       else args.resume)
+        if resume_path:
             from shadow1_tpu.ckpt import load_state
 
-            st = load_state(eng.init_state(), args.resume)
+            st = load_state(eng.init_state(), resume_path)
             metrics0 = Eng.metrics_dict(st)
+            done = int(st.win_start) // exp.window
             if args.windows is None:
                 # Complete the configured run: only the windows remaining
                 # after the checkpoint, not n_windows again on top of it.
-                done = int(st.win_start) // exp.window
                 args.windows = max(eng.n_windows - done, 0)
+            elif resume_path == args.ckpt:
+                # Supervised respawn: --windows is the TOTAL for the whole
+                # supervised run, not N more on top of the snapshot.
+                args.windows = max(args.windows - done, 0)
         import contextlib
 
         prof = (jax.profiler.trace(args.profile) if args.profile
                 else contextlib.nullcontext())
         with prof:
-            if args.heartbeat:
+            if args.heartbeat or args.ckpt:
                 from shadow1_tpu.obs import run_with_heartbeat
 
                 st, _hb = run_with_heartbeat(
-                    eng, st, n_windows=args.windows, every_windows=args.heartbeat
+                    eng, st, n_windows=args.windows,
+                    every_windows=args.heartbeat,
+                    # --ckpt without --heartbeat chunks the run for
+                    # checkpointing but emits no heartbeat lines.
+                    stream=None if args.heartbeat else False,
+                    ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
                 )
             else:
                 st = eng.run(st, n_windows=args.windows)
@@ -139,7 +223,7 @@ def main(argv=None) -> int:
         "wall_seconds": round(wall, 3),
         "sim_per_wall": round(sim_s / wall, 3) if wall > 0 else None,
         "events_per_sec": round(ev_run / wall, 1) if wall > 0 else None,
-        "resumed": bool(args.resume),
+        "resumed": bool(resume_path),
         "metrics": {k: int(v) for k, v in metrics.items()},
     }
     if args.summary:

@@ -63,6 +63,11 @@ class Metrics(NamedTuple):
     tcp_ooo_drops: jnp.ndarray   # out-of-order segments dropped (GBN receiver)
     x2x_overflow: jnp.ndarray    # packets dropped: all_to_all bucket full
                                  # (sharded engine only; parity needs 0)
+    x2x_max_fill: jnp.ndarray    # high-water DEMANDED per-destination bucket
+                                 # fill across the run (sharded exchange;
+                                 # pmax-replicated, so excluded from the
+                                 # cross-shard psum like ``windows``) — the
+                                 # quantity that rationally pins x2x_cap
     down_events: jnp.ndarray     # events discarded: host stopped (churn)
     down_pkts: jnp.ndarray       # packets dropped: destination host stopped
     nic_tx_drops: jnp.ndarray    # packets dropped: NIC uplink queue full
@@ -343,13 +348,13 @@ def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
 def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     """Window-end packet exchange: route, (all_to_all under sharding), scatter.
 
-    ``exchange`` maps FlatPackets → (FlatPackets, n_dropped) across the mesh
-    (identity on a single device; a bucketed all_to_all over the host axis
-    when sharded — the one collective per window, SURVEY §2.5)."""
+    ``exchange`` maps FlatPackets → (FlatPackets, n_dropped, fill_high_water)
+    across the mesh (identity on a single device; a bucketed all_to_all over
+    the host axis when sharded — the one collective per window, SURVEY §2.5)."""
     fp, n_sent, n_lost = route_outbox(ctx, st.outbox)
-    n_x2x = jnp.zeros((), jnp.int64)
+    n_x2x = x2x_hw = jnp.zeros((), jnp.int64)
     if exchange is not None:
-        fp, n_x2x = exchange(fp)
+        fp, n_x2x, x2x_hw = exchange(fp)
     evbuf, n_deliv, n_over, n_down = deliver_flat(st.evbuf, ctx, fp)
     m = st.metrics
     return st._replace(
@@ -361,6 +366,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             pkts_lost=m.pkts_lost + n_lost,
             ev_overflow=m.ev_overflow + n_over,
             x2x_overflow=m.x2x_overflow + n_x2x,
+            x2x_max_fill=jnp.maximum(m.x2x_max_fill, x2x_hw),
             down_pkts=m.down_pkts + n_down,
         ),
     )

@@ -147,6 +147,13 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     (time, tb) keys, so it is engine- and layout-independent.
     Returns (buf, n_overflow). ``p`` is [NP, N].
 
+    Overflow-victim selection is layout-defined: when a destination's free
+    slots run out, which packets drop depends on flat source order (since
+    the [C, H] rewrite: slot-major), so it differs across engines and
+    layout revisions. Cross-engine parity is guaranteed only for runs with
+    ``ev_overflow == 0`` — the oracle harness asserts this
+    (docs/SEMANTICS.md "Bounds and overflow").
+
     TPU tuning: the sort key packs (dst, flat index) into one integer so an
     *unstable* single-key sort is deterministic (keys are distinct and the
     packing preserves source order within a destination); segment bounds

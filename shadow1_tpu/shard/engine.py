@@ -237,6 +237,13 @@ class ShardedEngine:
                 dropped = (
                     fp.keep.sum(dtype=jnp.int64) - valid.sum(dtype=jnp.int64)
                 )
+                # Occupancy: the DEMANDED fill of this shard's busiest
+                # outbound bucket this window (can exceed x2x_cap — that is
+                # exactly when overflow happens), pmax'd so every shard
+                # carries the same global high-water mark.
+                fill_hw = jax.lax.pmax(
+                    (seg[1:] - seg[:-1]).max().astype(jnp.int64), axis
+                )
                 stacked = jnp.concatenate(
                     [
                         jnp.stack(
@@ -269,7 +276,7 @@ class ShardedEngine:
                     p=r[:, 6:-1].T,
                     keep=keep,
                 )
-                return out, dropped
+                return out, dropped, fill_hw
 
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
@@ -285,8 +292,12 @@ class ShardedEngine:
                 init_metrics,
             )
             # ``windows`` advances identically on every shard (replicated, like
-            # win_start) — keep the local count rather than the 8× sum.
-            return st._replace(metrics=mfin._replace(windows=st.metrics.windows))
+            # win_start) — keep the local count rather than the 8× sum; same
+            # for the pmax-replicated exchange high-water mark.
+            return st._replace(metrics=mfin._replace(
+                windows=st.metrics.windows,
+                x2x_max_fill=st.metrics.x2x_max_fill,
+            ))
 
         def run(st: SimState, n_windows) -> SimState:
             specs = self._state_specs(st)

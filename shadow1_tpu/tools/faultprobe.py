@@ -46,12 +46,20 @@ def main() -> int:
         return 1
 
     args = sys.argv[1:] or ["sort0", "scan0", "pop", "phold"]
-    # "tor N" consumes its window-count argument.
-    rest = args[1:] if args and args[0] == "tor" else []
-    todo = ["tor"] if rest else args
+    # Parse positionally: "tor" may be followed by a numeric window count
+    # anywhere in the list; mixed probe lists are fine.
+    todo: list[tuple[str, int]] = []
+    i = 0
+    while i < len(args):
+        name, n = args[i], 0
+        if name == "tor" and i + 1 < len(args) and args[i + 1].isdigit():
+            n = int(args[i + 1])
+            i += 1
+        todo.append((name, n))
+        i += 1
     H, C = 1000, 256
 
-    for probe in todo:
+    for probe, probe_n in todo:
         t0 = time.perf_counter()
         if probe == "sort0":
             t = jnp.asarray(np.random.randint(0, 1 << 40, (C, H)), jnp.int64)
@@ -111,7 +119,7 @@ def main() -> int:
             from shadow1_tpu.config.experiment import load_experiment
             from shadow1_tpu.core.engine import Engine
 
-            n = int(rest[0]) if rest else 50
+            n = probe_n or 50
             exp, params, _ = load_experiment("configs/rung3_tor1k.yaml")
             eng = Engine(exp, params)
             st = eng.run(eng.init_state(), n_windows=n)

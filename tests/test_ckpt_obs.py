@@ -84,3 +84,69 @@ def test_heartbeat_stream():
     assert lines[-1]["windows"] == 100
     assert sum(r["delta"]["events"] for r in lines) == int(st.metrics.events)
     assert all(r["type"] == "heartbeat" for r in lines)
+
+
+def test_cli_supervise_survives_device_fault(tmp_path):
+    """End-to-end --ckpt supervision: the child process is killed hard (the
+    fault-injection hook dies like a wedged TPU worker) after its first
+    checkpoint; the parent must respawn a fresh child that resumes from the
+    snapshot and finishes, and the final state must bit-match an
+    uninterrupted run of the same config."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = os.path.join(os.path.dirname(__file__), "..", "configs",
+                       "rung1_filexfer.yaml")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ref_npz = str(tmp_path / "ref.npz")
+    sup_npz = str(tmp_path / "sup.npz")
+    ck = str(tmp_path / "ck.npz")
+    base = [sys.executable, "-m", "shadow1_tpu", cfg, "--windows", "40"]
+    r = subprocess.run([*base, "--save-state", ref_npz],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+
+    # Crash at the sim clock of window 20 (window size from the config).
+    from shadow1_tpu.config.experiment import load_experiment
+
+    exp, _, _ = load_experiment(cfg)
+    env["SHADOW1_OBS_CRASH_AT_NS"] = str(20 * exp.window)
+    r = subprocess.run(
+        [*base, "--ckpt", ck, "--ckpt-every-s", "0", "--heartbeat", "10",
+         "--save-state", sup_npz],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, (r.stdout[-400:], r.stderr[-800:])
+    assert "respawning" in r.stderr  # the fault actually fired + recovered
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["resumed"] is True
+    with np.load(ref_npz) as a, np.load(sup_npz) as b:
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_heartbeat_ckpt_and_fault_resume(tmp_path):
+    """The fault-tolerant heartbeat path (round-4 postmortem: a device fault
+    mid-heartbeat-run lost the whole run): run_with_heartbeat(ckpt_path=...)
+    must leave a resumable snapshot + progress sidecar, and a fresh process'
+    worth of resume (load snapshot, run the remaining windows) must bit-match
+    an uninterrupted run — exactly what cli._supervise does after a crash."""
+    eng = phold_engine()
+    ref = eng.run(n_windows=100)
+    path = str(tmp_path / "hb.npz")
+    # "Crashed" run: only 50 of 100 windows happened before the fault.
+    run_with_heartbeat(eng, n_windows=50, every_windows=25, stream=False,
+                       ckpt_path=path, ckpt_every_s=0.0)
+    with open(path + ".progress") as f:
+        prog = json.load(f)
+    assert prog["done_windows"] == 50
+    assert prog["win_start"] == 50 * eng.window
+    # Supervised respawn: resume from the snapshot, finish the total.
+    st2 = load_state(eng.init_state(), path)
+    done = prog["win_start"] // eng.window
+    final, _hb = run_with_heartbeat(eng, st2, n_windows=100 - done,
+                                    every_windows=25, stream=False,
+                                    ckpt_path=path, ckpt_every_s=0.0)
+    assert state_equal(ref, final)

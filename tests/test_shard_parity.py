@@ -70,14 +70,22 @@ def test_x2x_bucket_overflow_is_counted():
         model="phold",
         model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 4},
     )
-    full = ShardedEngine(exp, EngineParams()).run()
+    sh_full = ShardedEngine(exp, EngineParams())
+    full = sh_full.run()
     fm = ShardedEngine.metrics_dict(full)
     assert fm["x2x_overflow"] == 0
+    # Occupancy observability: the busiest-bucket high-water mark is
+    # recorded, positive (traffic flowed), and within the cap that held.
+    assert 0 < fm["x2x_max_fill"] <= sh_full._x2x_cap
     with pytest.raises(RuntimeError, match="x2x_cap"):
         ShardedEngine(exp, EngineParams(x2x_cap=1)).run()
     tiny = ShardedEngine(exp, EngineParams(x2x_cap=1)).run(check_x2x=False)
     tm = ShardedEngine.metrics_dict(tiny)
     assert tm["x2x_overflow"] > 0
+    # The high-water mark records DEMANDED fill, so it exceeds the cap of 1
+    # exactly when overflow happens — users can read the needed cap off it.
+    assert tm["x2x_max_fill"] > 1
+    assert tm["x2x_max_fill"] == fm["x2x_max_fill"]  # demand is cap-independent
     # sent minus (lost + delivered + dropped buckets + full-evbuf drops) = 0
     assert (
         tm["pkts_sent"]
@@ -113,13 +121,13 @@ def test_x2x_auto_retry_convergent_traffic():
         assert m8[k] == m1[k], (k, m8[k], m1[k])
 
 
-@pytest.mark.slow
 def test_dryrun_multichip_gate():
     """Execute the driver's own multichip gate (__graft_entry__) so its exact
     parameterization is covered by CI — round 3 shipped a gate-only failure
-    because nothing in tests/ ran this path. Slow tier: ~5 sharded-program
-    compiles; the fast tier keeps the auto-retry test above as the
-    regression guard."""
+    because nothing in tests/ ran this path, and round 4 left this test in
+    the slow tier only, so the default ``./ci.sh`` could still go green while
+    the gate drifted. It costs ~5 sharded-program compiles (minutes) and is
+    budgeted into the fast tier deliberately."""
     import __graft_entry__ as ge  # repo root is on pythonpath (pyproject)
 
     ge.dryrun_multichip(8)
