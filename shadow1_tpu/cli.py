@@ -18,7 +18,17 @@ import time
 MAX_RESPAWNS = 8
 
 
-def _supervise(child_argv, ckpt_path) -> int:
+def _config_fingerprint(config_path: str) -> str:
+    """Identity of the experiment a --ckpt snapshot belongs to. Snapshot
+    leaf shapes alone cannot distinguish two configs that differ only in
+    scalars (seed, stop_time), so resume safety needs the config bytes."""
+    import hashlib
+
+    with open(config_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _supervise(child_argv, ckpt_path, config_path) -> int:
     """Parent side of ``--ckpt`` fault tolerance (the ladder's recipe,
     bench_ladder.py): run the CLI in a child process; when it dies with a
     checkpoint showing forward progress, respawn a fresh child that resumes
@@ -28,6 +38,26 @@ def _supervise(child_argv, ckpt_path) -> int:
     import subprocess
 
     sidecar = ckpt_path + ".progress"
+    meta_path = ckpt_path + ".meta"
+    # A snapshot left by an earlier interrupted run of a DIFFERENT config
+    # must not silently hijack this run (same leaf shapes would pass
+    # load_state's checks): fingerprint-mismatched leftovers are deleted.
+    fp = _config_fingerprint(config_path)
+    stale = False
+    if os.path.exists(ckpt_path):
+        try:
+            with open(meta_path) as f:
+                stale = json.load(f).get("config_sha256") != fp
+        except (OSError, ValueError):
+            stale = True
+    if stale:
+        print(f"[supervise] discarding stale checkpoint {ckpt_path} "
+              f"(different or unknown config)", file=sys.stderr, flush=True)
+        for p in (ckpt_path, sidecar, meta_path):
+            if os.path.exists(p):
+                os.remove(p)
+    with open(meta_path, "w") as f:
+        json.dump({"config_sha256": fp}, f)
     last_progress = -1
     rc = 1
     for attempt in range(MAX_RESPAWNS + 1):
@@ -37,7 +67,7 @@ def _supervise(child_argv, ckpt_path) -> int:
         if rc == 0:
             # A finished run's snapshot must not silently resume a later
             # invocation of the same command into a no-op.
-            for p in (ckpt_path, sidecar):
+            for p in (ckpt_path, sidecar, meta_path):
                 if os.path.exists(p):
                     os.remove(p)
             return 0
@@ -121,7 +151,7 @@ def main(argv=None) -> int:
         # Parent side of fault tolerance: never init the accelerator here —
         # all device work happens in supervised children.
         return _supervise(argv if argv is not None else sys.argv[1:],
-                          args.ckpt)
+                          args.ckpt, args.config)
     # Survive a dead/hanging accelerator backend. The CPU oracle needs jax
     # too (it mirrors the RNG streams), but never an accelerator — force
     # CPU directly and skip the probe cost.

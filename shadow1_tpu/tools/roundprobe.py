@@ -1,0 +1,206 @@
+"""Round-path primitive ablation — where do the ms/round actually go?
+
+    python -m shadow1_tpu.tools.roundprobe [probe ...] [--iters N]
+        [--hosts H] [--cap C]
+
+Round-5 context: the round-4 host-minor rewrite was justified by per-OP
+microbenchmarks (min-reduce 7× faster, payload HBM 12.8× smaller —
+docs/PERF.md) but the COMPOSITE engine round measured several times slower
+on-chip (phold ms/round 1.4 → 8.4; rung3/rung5 throughput down 2.6-4×).
+This tool times the actual engine primitives in isolation, warm, as jitted
+``fori_loop`` bodies carrying the buffer through the loop — the same data
+dependence the real round loop has — so the per-iteration cost attributes
+ms/round to a specific primitive instead of a shape microbenchmark.
+
+Probes (each prints us/iter):
+
+* ``pop``      — ``pop_until`` alone (the two min-reductions + one-hot
+                 extraction of kind, tb and the [NP,C,H] payload)
+* ``pop_nop``  — ``pop_until`` variant WITHOUT payload extraction (splits
+                 the extract_col cost out of ``pop``)
+* ``push``     — ``push_local`` alone (first-free search + 4 wheres)
+* ``cycle``    — push then pop (the minimal self-sustaining round kernel)
+* ``phold_win``— the full phold ``window_step`` (fori over windows), the
+                 composite these primitives should sum to
+* ``deliver``  — ``deliver_batch`` of H packets (the per-window merge)
+
+One JSON line per probe. Compare ``pop + push`` against ``phold_win``'s
+per-round cost: a large residual means the cost is in the round loop
+structure (cond gating, metrics plumbing, while_loop carry), not the event
+primitives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("probes", nargs="*",
+                    default=["pop", "pop_nop", "push", "cycle", "phold_win",
+                             "deliver"])
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--hosts", type=int, default=1000)
+    ap.add_argument("--cap", type=int, default=256)
+    args = ap.parse_args()
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow1_tpu.consts import MS, NP
+    from shadow1_tpu.core import events as ev
+
+    H, C, iters = args.hosts, args.cap, args.iters
+    print(json.dumps({"backend": jax.default_backend(), "hosts": H,
+                      "cap": C, "iters": iters}), flush=True)
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"error": "cpu backend — not the platform under "
+                                   "test"}))
+        return 1
+
+    rng = np.random.default_rng(7)
+
+    def seeded_buf(fill: int) -> ev.EventBuf:
+        """A buffer with ``fill`` live events per host at random times."""
+        buf = ev.evbuf_init(H, C)
+        t = jnp.asarray(rng.integers(0, 1 << 40, (C, H)), jnp.int64)
+        tb = jnp.asarray(rng.integers(0, 1 << 40, (C, H)), jnp.int64)
+        live = jnp.asarray(np.arange(C)[:, None] < fill, bool)
+        return buf._replace(
+            time=jnp.where(live, t, buf.time),
+            tb=jnp.where(live, tb, buf.tb),
+            kind=jnp.where(live, 1, buf.kind),
+        )
+
+    def timeit(name, make_step, carry0):
+        """us/iter of ``carry = step(carry)`` over ``iters`` fori rounds."""
+        def loop(carry, n):
+            return jax.lax.fori_loop(0, n, lambda _, c: make_step(c), carry)
+
+        f = jax.jit(loop, static_argnums=1)
+        # Warm with the SAME static iter count: jit caches per static arg,
+        # so warming with n=1 would leave the timed call paying a fresh
+        # compile of the n=iters program (seconds on the tunnel — it would
+        # swamp the microseconds under measurement).
+        jax.block_until_ready(f(carry0, iters))
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(carry0, iters))
+        wall = time.perf_counter() - t0
+        print(json.dumps({"probe": name,
+                          "us_per_iter": round(1e6 * wall / iters, 1)}),
+              flush=True)
+
+    until = jnp.int64(1 << 41)                      # everything eligible
+
+    for probe in args.probes:
+        if probe == "pop":
+            def step(buf):
+                buf, p = ev.pop_until(buf, until)
+                # keep the pop results live without re-inserting (the buffer
+                # drains over iters; seeded C slots >> iters keeps it warm)
+                return buf._replace(self_ctr=buf.self_ctr + p.time)
+
+            timeit("pop", step, seeded_buf(C))
+        elif probe == "pop_nop":
+            def step(buf):
+                # pop_until minus the payload/kind extraction: the two
+                # min-reductions and the buffer clear only.
+                elig = (buf.kind != 0) & (buf.time < until)
+                t_masked = jnp.where(elig, buf.time, ev.I64_MAX)
+                min_t = t_masked.min(axis=0)
+                tie = elig & (t_masked == min_t[None, :])
+                tb_masked = jnp.where(tie, buf.tb, ev.I64_MAX)
+                min_tb = tb_masked.min(axis=0)
+                sel = tie & (tb_masked == min_tb[None, :])
+                return buf._replace(
+                    kind=jnp.where(sel, 0, buf.kind),
+                    time=jnp.where(sel, ev.I64_MAX, buf.time),
+                    self_ctr=buf.self_ctr + min_t,
+                )
+
+            timeit("pop_nop", step, seeded_buf(C))
+        elif probe == "push":
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(buf):
+                buf2, _over = ev.push_local(
+                    buf, m, buf.self_ctr + 1, k, pay
+                )
+                # keep occupancy constant: restore kind so the buffer never
+                # fills (cost of the where is part of the probe's point)
+                return buf2._replace(kind=buf.kind)
+
+            timeit("push", step, seeded_buf(C // 2))
+        elif probe == "cycle":
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(buf):
+                buf, p = ev.pop_until(buf, until)
+                buf, _over = ev.push_local(buf, p.mask & m, p.time + 7, k,
+                                           pay)
+                return buf
+
+            timeit("cycle", step, seeded_buf(C // 2))
+        elif probe == "phold_win":
+            from shadow1_tpu.config.compiled import single_vertex_experiment
+            from shadow1_tpu.consts import EngineParams
+            from shadow1_tpu.core.engine import Engine
+
+            exp = single_vertex_experiment(
+                n_hosts=H, seed=77, end_time=10**15, latency_ns=30 * MS,
+                model="phold",
+                model_cfg={"mean_delay_ns": float(60 * MS),
+                           "init_events": 4},
+            )
+            eng = Engine(exp, EngineParams(ev_cap=C))
+            st0 = eng.run(eng.init_state(), n_windows=10)  # warm state
+            jax.block_until_ready(st0)
+            m0 = Engine.metrics_dict(st0)
+            t0 = time.perf_counter()
+            st1 = eng.run(st0, n_windows=iters)
+            jax.block_until_ready(st1)
+            wall = time.perf_counter() - t0
+            m1 = Engine.metrics_dict(st1)
+            rounds = m1["rounds"] - m0["rounds"]
+            print(json.dumps({
+                "probe": "phold_win",
+                "us_per_window": round(1e6 * wall / iters, 1),
+                "rounds_per_window": round(rounds / iters, 2),
+                "us_per_round": round(1e6 * wall / max(rounds, 1), 1),
+            }), flush=True)
+        elif probe == "deliver":
+            dst = jnp.asarray(rng.integers(0, H, H), jnp.int32)
+            t = jnp.asarray(rng.integers(0, 1 << 40, H), jnp.int64)
+            tb = jnp.asarray(rng.integers(0, 1 << 40, H), jnp.int64)
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(buf):
+                buf2, _over = ev.deliver_batch(buf, dst, t, tb, k, pay, m)
+                # hold occupancy: keep the timing honest across iters
+                return buf2._replace(kind=buf.kind, time=buf.time)
+
+            timeit("deliver", step, seeded_buf(C // 2))
+        else:
+            print(json.dumps({"error": f"unknown probe {probe!r}"}))
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

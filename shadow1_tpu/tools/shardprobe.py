@@ -1,0 +1,184 @@
+"""Sharding performance probes: exchange overhead and mesh scaling shape.
+
+    python -m shadow1_tpu.tools.shardprobe overhead [--config PATH] [--windows N]
+    python -m shadow1_tpu.tools.shardprobe scale [--devices 1,2,4,8]
+        [--windows N] [--json PATH]
+
+The 20× north star names a v5e-8; host-axis sharding is the only 8× lever
+(SURVEY §2.5), so its costs need numbers, not design notes:
+
+* ``overhead`` — same experiment, plain ``Engine`` vs a **1-device-mesh**
+  ``ShardedEngine``, on the DEFAULT backend (the real chip when alive).
+  The delta is the price of the shard_map program structure + the bucketed
+  all_to_all exchange with no actual cross-device traffic — the fixed cost
+  sharding must amortize.
+* ``scale`` — the sharded engine on 1/2/4/8 **virtual CPU devices** (the
+  same ``xla_force_host_platform_device_count`` recipe as tests/conftest),
+  each device count in a fresh child process. CPU-mesh walls say nothing
+  about TPU walls, but the SHAPE (how throughput moves as the same work
+  spreads over more shards, exchange included) is the first scaling datum
+  this repo can produce without multi-chip hardware.
+
+Workloads: the rung-3 Tor net (sparse rounds — the hard case) and the
+dense tgen mesh at 2k hosts (the design-point case), both overflow-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+TOR_CFG = "configs/rung3_tor1k.yaml"
+
+
+def _dense_exp(n_hosts: int = 2000):
+    from shadow1_tpu.config.experiment import build_experiment
+    from shadow1_tpu.tools.crossover import dense_doc
+
+    return build_experiment(dense_doc(n_hosts))
+
+
+def _timed_run(make_engine, windows: int, chunk: int):
+    """(compile_s, wall_s, metrics) with compile excluded via 0-window call."""
+    import jax
+
+    eng = make_engine()
+    t0 = time.perf_counter()
+    jax.block_until_ready(eng.run(eng.init_state(), n_windows=0))
+    compile_s = time.perf_counter() - t0
+    st = eng.init_state()
+    done = 0
+    t0 = time.perf_counter()
+    while done < windows:
+        step = min(chunk, windows - done)
+        st = eng.run(st, n_windows=step)
+        jax.block_until_ready(st)
+        done += step
+    wall = time.perf_counter() - t0
+    return compile_s, wall, type(eng).metrics_dict(st)
+
+
+def overhead_main(config: str, windows: int) -> int:
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+    import jax
+
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.core.engine import Engine
+    from shadow1_tpu.shard.engine import ShardedEngine
+
+    exp, params, _ = load_experiment(config)
+    out = {"mode": "overhead", "config": config, "windows": windows,
+           "backend": jax.default_backend()}
+    for name, mk in (
+        ("plain", lambda: Engine(exp, params)),
+        ("mesh1", lambda: ShardedEngine(exp, params,
+                                        devices=jax.devices()[:1])),
+    ):
+        c, w, m = _timed_run(mk, windows, chunk=20)
+        out[name] = {
+            "compile_s": round(c, 2), "wall_s": round(w, 3),
+            "events": m["events"],
+            "events_per_sec": round(m["events"] / w, 1) if w else None,
+        }
+        if name == "mesh1":
+            out["x2x_max_fill"] = m["x2x_max_fill"]
+    if out["plain"]["wall_s"] and out["mesh1"]["wall_s"]:
+        out["mesh1_over_plain_wall"] = round(
+            out["mesh1"]["wall_s"] / out["plain"]["wall_s"], 3
+        )
+        # Same experiment, same windows: the parity contract applies.
+        out["events_match"] = out["plain"]["events"] == out["mesh1"]["events"]
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def scale_child(n_dev: int, windows: int) -> int:
+    # Env-only JAX_PLATFORMS mutation loses to the environment's preset axon
+    # plugin (tests/conftest.py) — force CPU via the config route, which
+    # also raises the virtual device count pre-init.
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import force_cpu
+
+    force_cpu(n_dev)
+    import jax
+
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.shard.engine import ShardedEngine
+
+    assert jax.default_backend() == "cpu" and len(jax.devices()) >= n_dev
+    rows = {}
+    tor = load_experiment(TOR_CFG)
+    dense = _dense_exp()
+    for name, (exp, params, _s) in (("tor1k", tor), ("dense2k", dense)):
+        c, w, m = _timed_run(
+            lambda: ShardedEngine(exp, params, devices=jax.devices()[:n_dev]),
+            windows, chunk=20,
+        )
+        rows[name] = {
+            "compile_s": round(c, 2), "wall_s": round(w, 3),
+            "events": m["events"],
+            "events_per_sec": round(m["events"] / w, 1) if w else None,
+            "x2x_max_fill": m["x2x_max_fill"],
+            "ev_overflow": m["ev_overflow"],
+        }
+    print(json.dumps({"mode": "scale", "n_dev": n_dev, "windows": windows,
+                      **rows}))
+    return 0
+
+
+def scale_main(devices: list[int], windows: int, json_path: str | None) -> int:
+    rows = []
+    for n in devices:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={max(n, 1)}"]
+        )
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "shadow1_tpu.tools.shardprobe",
+                 "scale-child", str(n), "--windows", str(windows)],
+                capture_output=True, text=True, env=env, timeout=3000,
+            )
+            row = json.loads(r.stdout.strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            row = {"mode": "scale", "n_dev": n, "error": "child >3000s"}
+        except (IndexError, ValueError):
+            row = {"mode": "scale", "n_dev": n,
+                   "error": r.stderr[-300:] or f"rc={r.returncode}"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(rows, f, indent=1)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["overhead", "scale", "scale-child"])
+    ap.add_argument("n_dev", nargs="?", type=int, default=None)
+    ap.add_argument("--config", default=TOR_CFG)
+    ap.add_argument("--windows", type=int, default=200)
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    if args.mode == "overhead":
+        return overhead_main(args.config, args.windows)
+    if args.mode == "scale-child":
+        return scale_child(args.n_dev, args.windows)
+    return scale_main([int(x) for x in args.devices.split(",")],
+                      args.windows, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,36 @@ def test_cli_supervise_survives_device_fault(tmp_path):
             np.testing.assert_array_equal(a[k], b[k], err_msg=k)
 
 
+def test_cli_supervise_discards_stale_checkpoint(tmp_path):
+    """A snapshot left by an interrupted run of a DIFFERENT config must not
+    hijack a later run that happens to share tensor shapes: the supervisor
+    fingerprints the config and deletes mismatched leftovers."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = os.path.join(os.path.dirname(__file__), "..", "configs",
+                       "rung1_filexfer.yaml")
+    ck = str(tmp_path / "ck.npz")
+    # Manufacture a leftover from "some other config": a real snapshot of
+    # this engine (shapes match) with a wrong config fingerprint.
+    eng = phold_engine()
+    run_with_heartbeat(eng, n_windows=20, every_windows=10, stream=False,
+                       ckpt_path=ck, ckpt_every_s=0.0)
+    with open(ck + ".meta", "w") as f:
+        json.dump({"config_sha256": "not-this-config"}, f)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow1_tpu", cfg, "--windows", "5",
+         "--ckpt", ck],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "discarding stale checkpoint" in r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["resumed"] is False  # ran fresh, not from the leftover
+
+
 def test_heartbeat_ckpt_and_fault_resume(tmp_path):
     """The fault-tolerant heartbeat path (round-4 postmortem: a device fault
     mid-heartbeat-run lost the whole run): run_with_heartbeat(ckpt_path=...)
