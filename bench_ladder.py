@@ -68,7 +68,8 @@ RC_FAULT = 3                   # child: device fault, checkpoint is resumable
 # Child: run one rung (possibly resuming), checkpoint each chunk, report.
 # --------------------------------------------------------------------------
 def child_main(name: str, path: str, state_path: str, report_path: str,
-               total_override: int | None, chunk0: int, budget_s: float) -> int:
+               total_override: int | None, chunk0: int, budget_s: float,
+               engine_spec: str | None = None) -> int:
     import shadow1_tpu  # noqa: F401
     from shadow1_tpu.platform import ensure_live_platform
 
@@ -79,7 +80,10 @@ def child_main(name: str, path: str, state_path: str, report_path: str,
     from shadow1_tpu.config.experiment import load_experiment
     from shadow1_tpu.core.engine import Engine
 
+    from shadow1_tpu.config.experiment import apply_engine_overrides
+
     exp, params, _scheduler = load_experiment(path)
+    params = apply_engine_overrides(params, engine_spec)
     eng = Engine(exp, params)
     total = total_override or eng.n_windows
 
@@ -175,7 +179,8 @@ def child_main(name: str, path: str, state_path: str, report_path: str,
 # Parent: respawn children across faults, aggregate walls, add the oracle.
 # --------------------------------------------------------------------------
 def run_rung(name: str, path: str, windows_override: int | None,
-             chunk0: int, budget_s: float, workdir: str, rep: int = 0) -> dict:
+             chunk0: int, budget_s: float, workdir: str, rep: int = 0,
+             engine_spec: str | None = None) -> dict:
     state_path = os.path.join(workdir, f"{name}.r{rep}.state.npz")
     report_path = os.path.join(workdir, f"{name}.r{rep}.report.json")
     wall = compile_total = ckpt_total = 0.0
@@ -203,6 +208,8 @@ def run_rung(name: str, path: str, windows_override: int | None,
                "--budget-s", str(remaining)]
         if windows_override:
             cmd += ["--windows", str(windows_override)]
+        if engine_spec:
+            cmd += ["--engine", engine_spec]
         r = subprocess.run(cmd, capture_output=True, text=True)
         if not os.path.exists(report_path):
             raise RuntimeError(
@@ -254,6 +261,7 @@ def run_rung(name: str, path: str, windows_override: int | None,
         "rung": name,
         "config": path,
         "commit": _git_head(),
+        "engine_overrides": engine_spec,
         "status": rec["status"],
         "n_hosts": exp.n_hosts,
         "windows": done,
@@ -292,7 +300,8 @@ def _git_head() -> str:
     return r.stdout.strip() or "?"
 
 
-def run_cpp_comparator(name: str, path: str, tpu_row: dict) -> dict:
+def run_cpp_comparator(name: str, path: str, tpu_row: dict,
+                       engine_spec: str | None = None) -> dict:
     """The honest thread-per-core C++ baseline on the same rung, same window
     count — its counters bit-match both engines (tests/test_native_
     comparator.py), so its wall clock is the denominator of the north-star
@@ -300,9 +309,10 @@ def run_cpp_comparator(name: str, path: str, tpu_row: dict) -> dict:
     import os as _os
 
     from shadow1_tpu import native
-    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.config.experiment import apply_engine_overrides, load_experiment
 
     exp, params, _ = load_experiment(path)
+    params = apply_engine_overrides(params, engine_spec)
     windows = tpu_row["windows"]
     if not windows:
         return {"cpp_skipped": "no measured windows"}
@@ -330,12 +340,14 @@ def run_cpp_comparator(name: str, path: str, tpu_row: dict) -> dict:
     return out
 
 
-def run_oracle_slice(name: str, path: str, tpu_row: dict) -> dict:
+def run_oracle_slice(name: str, path: str, tpu_row: dict,
+                     engine_spec: str | None = None) -> dict:
     """Bounded oracle run: whole windows until the event budget is hit."""
-    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.config.experiment import apply_engine_overrides, load_experiment
     from shadow1_tpu.cpu_engine import CpuEngine
 
     exp, params, _ = load_experiment(path)
+    params = apply_engine_overrides(params, engine_spec)
     if exp.n_hosts * params.sockets_per_host > 500_000:
         # The eager oracle allocates one Python object per socket; at rung-4
         # scale that is >1M objects — skip rather than swap the box.
@@ -368,6 +380,9 @@ def main() -> None:
                     help="per-rung timed-wall budget (chunk-boundary stop)")
     ap.add_argument("--json", default=None)
     ap.add_argument("--no-oracle", action="store_true")
+    ap.add_argument("--engine", default=None,
+                    help="EngineParams overrides, e.g. "
+                         "'compact_cap=384,pop_extract=gather' (A/B knob)")
     ap.add_argument("--repeats", type=int, default=1,
                     help="measure each rung N times (fresh state per rep); "
                          "the row reports the median-throughput rep plus "
@@ -384,7 +399,8 @@ def main() -> None:
     if args.child:
         path, _chunk0 = RUNGS[args.child]
         sys.exit(child_main(args.child, path, args.state, args.report,
-                            args.windows, args.chunk, args.budget_s))
+                            args.windows, args.chunk, args.budget_s,
+                            engine_spec=args.engine))
 
     import shadow1_tpu  # noqa: F401
     from shadow1_tpu.platform import ensure_live_platform
@@ -401,7 +417,8 @@ def main() -> None:
             reps = []
             for rep in range(max(args.repeats, 1)):
                 r = run_rung(name, path, args.windows, chunk0,
-                             args.budget_s, workdir, rep=rep)
+                             args.budget_s, workdir, rep=rep,
+                             engine_spec=args.engine)
                 reps.append(r)
                 if args.repeats > 1:
                     eps_s = (f"{r['events_per_sec']:,.0f} ev/s"
@@ -420,12 +437,14 @@ def main() -> None:
                 row["events_per_sec_reps"] = eps
                 row["sim_per_wall_reps"] = spw
             if not args.no_oracle:
-                row.update(run_oracle_slice(name, path, row))
+                row.update(run_oracle_slice(name, path, row,
+                                            engine_spec=args.engine))
                 if row.get("oracle_events_per_sec") and row["events_per_sec"]:
                     row["vs_oracle"] = round(
                         row["events_per_sec"] / row["oracle_events_per_sec"], 2
                     )
-            row.update(run_cpp_comparator(name, path, row))
+            row.update(run_cpp_comparator(name, path, row,
+                                          engine_spec=args.engine))
         except Exception as e:  # noqa: BLE001 — record the failure, keep going
             import traceback
 

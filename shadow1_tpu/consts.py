@@ -128,6 +128,18 @@ class EngineParams:
     # per window. 0 = auto (2× the uniform-traffic expectation, min 16).
     # Bucket-full drops are counted (x2x_overflow); parity requires 0.
     x2x_cap: int = 0
+    # Sparse-window compaction bucket (active-host lanes per window; see
+    # core/compact.py). 0 = off. Windows whose active-host count exceeds
+    # the bucket run full-width — results are bit-identical either way, so
+    # this is purely a perf knob. Size from tools/activeprobe.py (rung3
+    # p99 = 284 of 1000; rung4 max = 1082 of 10000).
+    compact_cap: int = 0
+    # Pop-min result extraction: "sum" (masked-sum over the one-hot — the
+    # round-4 default) or "gather" (index via min-over-iota, then
+    # take_along_axis — the round-3 style on the round-4 layout). Bit-exact
+    # either way (the one-hot is exact); a perf A/B knob for the round-path
+    # regression hunt (docs/PERF.md round-5).
+    pop_extract: str = "sum"
 
     # --- TCP constants (reference: src/main/host/descriptor/tcp.c) ---
     mss: int = 1460               # bytes per segment

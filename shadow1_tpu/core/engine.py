@@ -233,7 +233,7 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     the dead passes cuts the round cost correspondingly (handlers draw RNG
     and advance counters only where masked, so an all-false pass is a
     no-op by construction and skipping it is exact)."""
-    evbuf, ev = pop_until(st.evbuf, win_end)
+    evbuf, ev = pop_until(st.evbuf, win_end, extract=ctx.params.pop_extract)
     st = st._replace(evbuf=evbuf)
     m = st.metrics
     n_down = jnp.zeros((), jnp.int64)
@@ -372,21 +372,11 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     )
 
 
-def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
-                pre_window=None) -> SimState:
-    """One conservative window: inner rounds to quiescence, then delivery.
+def run_rounds(st: SimState, ctx: Ctx, handlers: dict, win_end):
+    """The inner round loop to quiescence (or the safety cap).
 
-    The batched form of the reference's barrier round
-    (scheduler_continueNextRound in src/main/core/scheduler/scheduler.c):
-    the while_loop plays the worker event loop, the delivery plays the
-    cross-thread event push that the barrier makes safe.
-
-    ``pre_window(st, ctx, win_end)`` is an optional model hook that runs
-    before the rounds — the net model uses it to batch-process every NIC
-    arrival of the window in one scan instead of one round per packet."""
-    win_end = st.win_start + ctx.window
-    if pre_window is not None:
-        st = pre_window(st, ctx, win_end)
+    Returns (st, cap_hit). Shared by the full-width path and the compacted
+    path (core/compact.py), which calls it at bucket width."""
     max_rounds = ctx.params.max_rounds
 
     def cond(carry):
@@ -398,7 +388,37 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
         return run_round(s, ctx, handlers, win_end), r + 1
 
     st, r = jax.lax.while_loop(cond, body, (st, jnp.zeros((), jnp.int32)))
-    cap_hit = (r >= max_rounds) & any_eligible(st.evbuf, win_end)
+    return st, (r >= max_rounds) & any_eligible(st.evbuf, win_end)
+
+
+def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
+                pre_window=None, make_handlers=None) -> SimState:
+    """One conservative window: inner rounds to quiescence, then delivery.
+
+    The batched form of the reference's barrier round
+    (scheduler_continueNextRound in src/main/core/scheduler/scheduler.c):
+    the while_loop plays the worker event loop, the delivery plays the
+    cross-thread event push that the barrier makes safe.
+
+    ``pre_window(st, ctx, win_end)`` is an optional model hook that runs
+    before the rounds — the net model uses it to batch-process every NIC
+    arrival of the window in one scan instead of one round per packet.
+
+    When ``params.compact_cap`` is set (and ``make_handlers`` provided),
+    sparse windows run their rounds on a gathered active-host bucket
+    (core/compact.py) — bit-identical results, narrow tensors."""
+    win_end = st.win_start + ctx.window
+    if pre_window is not None:
+        st = pre_window(st, ctx, win_end)
+    ccap = ctx.params.compact_cap
+    if ccap and ccap < ctx.n_hosts and make_handlers is not None:
+        from shadow1_tpu.core.compact import compact_window_rounds
+
+        st, cap_hit = compact_window_rounds(
+            st, ctx, handlers, make_handlers, run_rounds, win_end, ccap
+        )
+    else:
+        st, cap_hit = run_rounds(st, ctx, handlers, win_end)
     st = deliver_window(st, ctx, exchange)
     m = st.metrics
     return st._replace(
@@ -540,7 +560,8 @@ class Engine:
     # -- window step pieces ----------------------------------------------
     def _window_step(self, st: SimState) -> SimState:
         return window_step(st, self.ctx, self._handlers,
-                           pre_window=self._pre_window)
+                           pre_window=self._pre_window,
+                           make_handlers=self._model.make_handlers)
 
     def _make_run(self):
         def run(st: SimState, n_windows) -> SimState:

@@ -102,11 +102,17 @@ def push_back(buf: EventBuf, mask, time, tb, kind, p) -> tuple[EventBuf, jnp.nda
     return buf, mask & ~has_free
 
 
-def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
+def pop_until(buf: EventBuf, until, extract: str = "sum") -> tuple[EventBuf, Popped]:
     """Per-host pop of the minimum-(time, tb) event with time < until.
 
     Two min-reductions over the slot (sublane) axis + an equality one-hot;
-    exact because (time, tb) is unique per host (module docstring)."""
+    exact because (time, tb) is unique per host (module docstring).
+
+    ``extract`` selects how kind/payload leave the buffer — "sum" (masked
+    sum over the one-hot) or "gather" (one-hot → index → take_along_axis).
+    Both are exact; which is faster is a backend/layout question
+    (EngineParams.pop_extract, docs/PERF.md round-5)."""
+    assert extract in ("sum", "gather"), f"bad pop_extract {extract!r}"
     elig = (buf.kind != K_NONE) & (buf.time < until)
     t_masked = jnp.where(elig, buf.time, I64_MAX)
     min_t = t_masked.min(axis=0)
@@ -115,11 +121,20 @@ def pop_until(buf: EventBuf, until) -> tuple[EventBuf, Popped]:
     tb_masked = jnp.where(tie, buf.tb, I64_MAX)
     min_tb = tb_masked.min(axis=0)
     sel = tie & (tb_masked == min_tb[None, :])      # one-hot per active host
+    if extract == "gather":
+        from shadow1_tpu.core.dense import first_true_idx, get_col
+
+        _, slot = first_true_idx(sel)
+        kind = jnp.where(mask, get_col(buf.kind, slot), K_NONE)
+        pay = jnp.where(mask[None, :], get_col(buf.p, slot), 0)
+    else:
+        kind = extract_col(sel, buf.kind)
+        pay = extract_col(sel, buf.p)
     ev = Popped(
         mask=mask,
         time=jnp.where(mask, min_t, 0),
-        kind=extract_col(sel, buf.kind),
-        p=extract_col(sel, buf.p),
+        kind=kind,
+        p=pay,
         tb=jnp.where(mask, min_tb, 0),
     )
     buf = buf._replace(

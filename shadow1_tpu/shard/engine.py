@@ -163,6 +163,19 @@ class ShardedEngine:
     def _make_run(self, x2x_cap: int):
         exp, pr, axis = self.exp, self.params, self.axis
         n_dev, h_local = self.n_dev, self.h_local
+        if pr.compact_cap:
+            # compact_cap is sized against the GLOBAL active set (configs,
+            # tools/activeprobe.py); each shard block sees ~1/n_dev of it.
+            # Scale to per-shard lanes, rounded up to a lane tile (128) so
+            # the bucket stays tiling-friendly; shards whose active count
+            # overflows the bucket fall back full-width per window (exact
+            # either way — core/compact.py).
+            local_cap = -(-pr.compact_cap // n_dev)
+            tile = 128 if local_cap >= 128 else 8
+            local_cap = min(-(-local_cap // tile) * tile, h_local)
+            import dataclasses as _dc
+
+            pr = _dc.replace(pr, compact_cap=local_cap)
         window, model = self.window, self._model
         key = self.global_ctx.key
         lat_vv = self.global_ctx.lat_vv
@@ -281,7 +294,8 @@ class ShardedEngine:
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
                 0, n_windows,
-                lambda _, s: window_step(s, ctx, handlers, exchange, pre_window),
+                lambda _, s: window_step(s, ctx, handlers, exchange, pre_window,
+                                         make_handlers=model.make_handlers),
                 st,
             )
             # Each shard accumulated its own partials on top of the (replicated)

@@ -18,8 +18,18 @@ Probes (each prints us/iter):
                  extraction of kind, tb and the [NP,C,H] payload)
 * ``pop_nop``  — ``pop_until`` variant WITHOUT payload extraction (splits
                  the extract_col cost out of ``pop``)
+* ``pop_gat``  — ``pop_until`` variant extracting kind/tb/payload by
+                 index-gather (first_true_idx + get_col) instead of the
+                 masked-sum ``extract_col`` — the round-3 extraction style
+                 on the round-4 layout (A/B for the regression hunt)
 * ``push``     — ``push_local`` alone (first-free search + 4 wheres)
 * ``cycle``    — push then pop (the minimal self-sustaining round kernel)
+* ``wcycle``   — the same cycle under ``lax.while_loop`` with the engine's
+                 ``any_eligible`` cond (isolates loop-structure cost:
+                 wcycle − cycle ≈ what the while/cond machinery adds)
+* ``rng``      — the phold handler's two hash draws + exponential + randint
+                 (the non-event-buffer half of a phold round)
+* ``obox``     — ``outbox_append`` alone (5 ``set_col`` one-hot writes)
 * ``phold_win``— the full phold ``window_step`` (fori over windows), the
                  composite these primitives should sum to
 * ``deliver``  — ``deliver_batch`` of H packets (the per-window merge)
@@ -40,11 +50,14 @@ import time
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("probes", nargs="*",
-                    default=["pop", "pop_nop", "push", "cycle", "phold_win",
-                             "deliver"])
+                    default=["pop", "pop_nop", "pop_gat", "push", "cycle",
+                             "wcycle", "rng", "obox", "phold_win", "deliver"])
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--hosts", type=int, default=1000)
     ap.add_argument("--cap", type=int, default=256)
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run even on the CPU backend (smoke/compile check "
+                         "only — CPU timings do not attribute TPU cost)")
     args = ap.parse_args()
 
     import shadow1_tpu  # noqa: F401
@@ -61,7 +74,7 @@ def main() -> int:
     H, C, iters = args.hosts, args.cap, args.iters
     print(json.dumps({"backend": jax.default_backend(), "hosts": H,
                       "cap": C, "iters": iters}), flush=True)
-    if jax.default_backend() == "cpu":
+    if jax.default_backend() == "cpu" and not args.allow_cpu:
         print(json.dumps({"error": "cpu backend — not the platform under "
                                    "test"}))
         return 1
@@ -127,6 +140,92 @@ def main() -> int:
                 )
 
             timeit("pop_nop", step, seeded_buf(C))
+        elif probe == "pop_gat":
+            from shadow1_tpu.core.dense import first_true_idx, get_col
+
+            def step(buf):
+                elig = (buf.kind != 0) & (buf.time < until)
+                t_masked = jnp.where(elig, buf.time, ev.I64_MAX)
+                min_t = t_masked.min(axis=0)
+                mask = elig.any(axis=0)
+                tie = elig & (t_masked == min_t[None, :])
+                tb_masked = jnp.where(tie, buf.tb, ev.I64_MAX)
+                min_tb = tb_masked.min(axis=0)
+                sel = tie & (tb_masked == min_tb[None, :])
+                _, slot = first_true_idx(sel)
+                kind = jnp.where(mask, get_col(buf.kind, slot), 0)
+                pay = jnp.where(mask[None, :], get_col(buf.p, slot), 0)
+                return buf._replace(
+                    kind=jnp.where(sel, 0, buf.kind),
+                    time=jnp.where(sel, ev.I64_MAX, buf.time),
+                    self_ctr=buf.self_ctr + min_t + kind + pay[0],
+                )
+
+            timeit("pop_gat", step, seeded_buf(C))
+        elif probe == "wcycle":
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def wloop(buf, n):
+                def cond(carry):
+                    b, r = carry
+                    return (r < n) & ev.any_eligible(b, until)
+
+                def body(carry):
+                    b, r = carry
+                    b, p = ev.pop_until(b, until)
+                    b, _over = ev.push_local(b, p.mask & m, p.time + 7, k,
+                                             pay)
+                    return b, r + 1
+
+                buf, _ = jax.lax.while_loop(
+                    cond, body, (buf, jnp.zeros((), jnp.int32))
+                )
+                return buf
+
+            f = jax.jit(wloop, static_argnums=1)
+            carry0 = seeded_buf(C // 2)
+            jax.block_until_ready(f(carry0, iters))
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(carry0, iters))
+            wall = time.perf_counter() - t0
+            print(json.dumps({"probe": "wcycle",
+                              "us_per_iter": round(1e6 * wall / iters, 1)}),
+                  flush=True)
+        elif probe == "rng":
+            from shadow1_tpu import rng as prng
+            from shadow1_tpu.consts import R_PHOLD_DELAY, R_PHOLD_DST
+
+            key = prng.base_key(7)
+            hosts = jnp.arange(H, dtype=jnp.int32)
+
+            def step(ctr):
+                delay = prng.exponential_ns(
+                    prng.bits_v(key, R_PHOLD_DELAY, hosts, ctr), 1e6
+                )
+                dst = prng.randint(
+                    prng.bits_v(key, R_PHOLD_DST, hosts, ctr), H
+                )
+                return ctr + 1 + (delay % 2) + dst.astype(jnp.int64)
+
+            timeit("rng", step, jnp.zeros(H, jnp.int64))
+        elif probe == "obox":
+            from shadow1_tpu.core import outbox as ob
+
+            dst = jnp.ones(H, jnp.int32)
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(box):
+                box2, _ok = ob.outbox_append(
+                    box, m, dst, k, box.pkt_ctr + 7, pay
+                )
+                # hold occupancy so the append never saturates over iters
+                return box2._replace(cnt=box.cnt)
+
+            timeit("obox", step, ob.outbox_init(H, 64))
         elif probe == "push":
             k = jnp.ones(H, jnp.int32)
             pay = jnp.zeros((NP, H), jnp.int32)

@@ -68,3 +68,25 @@ def test_deliver_batch_ranks_and_overflow():
     # Host 1 keeps its two earliest-listed packets (rank order), pops in time order.
     buf, ev = pop_until(buf, jnp.int64(10**9))
     assert ev.time.tolist()[1] == 10 and ev.time.tolist()[2] == 40
+
+
+def test_pop_extract_gather_matches_sum():
+    """The two pop_until extraction modes are bit-identical (perf A/B knob,
+    EngineParams.pop_extract)."""
+    rng = np.random.default_rng(3)
+    h, c = 5, 8
+    buf = evbuf_init(h, c)
+    k = jnp.full(h, K_PHOLD, jnp.int32)
+    for _ in range(c - 1):
+        m = jnp.asarray(rng.random(h) < 0.8)
+        t = jnp.asarray(rng.integers(1, 1000, h), jnp.int64)
+        p = jnp.asarray(rng.integers(0, 99, (NP, h)), jnp.int32)
+        buf, _ = push_local(buf, m, t, k, p)
+    a, b = buf, buf
+    for _ in range(c):
+        a, ea = pop_until(a, jnp.int64(10**9), extract="sum")
+        b, eb = pop_until(b, jnp.int64(10**9), extract="gather")
+        for fa, fb in zip(ea, eb):
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    for fa, fb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
