@@ -20,7 +20,9 @@ import jax
 #   1: round 2-3 host-major layout
 #   2: round 4 host-minor layout ([C,H]/[S,H]/[NP,C,H] tensors)
 #   3: round 5 adds Metrics.x2x_max_fill (exchange occupancy high-water)
-CKPT_FORMAT = 3
+#   4: round 5 i32 round path — EventBuf gains t32/epoch, tb splits into
+#      (tb_hi, tb_lo) i32 planes (core/events.py)
+CKPT_FORMAT = 4
 
 
 def _flatten(st):

@@ -106,6 +106,14 @@ class CompiledExperiment:
             "RED needs 0 < aqm_pmax <= 1 where enabled"
         )
         assert self.end_time > 0
+        assert int(self.window) < 2**31 - 1, (
+            "conservative window must fit the i32 rebased pop keys "
+            "(core/events.py t32): window < 2**31 - 1 ns (~2.1 s; the last "
+            "value is the clamp sentinel I32_HORIZON, so an event exactly "
+            "window-1 ahead must still rebase exactly). Topologies with "
+            "multi-second minimum latency are out of this engine's design "
+            "envelope."
+        )
 
 
 def single_vertex_experiment(

@@ -140,6 +140,16 @@ class EngineParams:
     # either way (the one-hot is exact); a perf A/B knob for the round-path
     # regression hunt (docs/PERF.md round-5).
     pop_extract: str = "sum"
+    # Pop-min implementation: "xla" (the masked-reduction chain in
+    # core/events.py) or "pallas" (the fused single-pass VMEM kernel in
+    # core/popk.py — one HBM read/write per plane instead of ~12 full-plane
+    # passes). Bit-exact either way (tests/test_events.py); a perf knob
+    # pending on-chip A/B (docs/PERF.md round-5).
+    pop_impl: str = "xla"
+    # Push implementation, same contract: "xla" (first-free + one-hot
+    # wheres) or "pallas" (core/popk.py fused single-pass kernel). Scoped
+    # into the handler layers at trace time via events.push_impl_ctx.
+    push_impl: str = "xla"
 
     # --- TCP constants (reference: src/main/host/descriptor/tcp.c) ---
     mss: int = 1460               # bytes per segment
@@ -153,6 +163,15 @@ class EngineParams:
 
     def __post_init__(self):
         assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
+        assert self.pop_extract in ("sum", "gather"), self.pop_extract
+        assert self.pop_impl in ("xla", "pallas"), self.pop_impl
+        assert self.push_impl in ("xla", "pallas"), self.push_impl
+        # The fused pop kernel extracts via the one-hot masked sum only; a
+        # silent no-op pop_extract would corrupt exactly the A/B this knob
+        # exists for.
+        assert not (self.pop_impl == "pallas" and self.pop_extract != "sum"), (
+            "pop_impl='pallas' implies pop_extract='sum'"
+        )
 
 
 # App notification flags (per-round, host-level — set by the transport layer,

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import K_NONE
-from shadow1_tpu.core.events import I64_MAX
+from shadow1_tpu.core.events import I32_FREE, until32
 
 # Ctx fields indexed by LOCAL host lane (everything else — vertex tables,
 # host_vertex (global-id-indexed), scalars, static flags — stays as is).
@@ -47,8 +47,12 @@ _CTX_HOST_FIELDS = (
 
 
 def active_mask(evbuf, win_end) -> jnp.ndarray:
-    """bool [H]: host has ≥1 eligible event this window (= will pop)."""
-    return ((evbuf.kind != K_NONE) & (evbuf.time < win_end)).any(axis=0)
+    """bool [H]: host has ≥1 eligible event this window (= will pop).
+
+    Runs after the window-start rebase (core/engine.py window_step), so the
+    i32 t32 plane is current — no i64 pass here."""
+    u32 = until32(evbuf, win_end)
+    return ((evbuf.kind != K_NONE) & (evbuf.t32 < u32)).any(axis=0)
 
 
 def compact_perm(active: jnp.ndarray, cap: int):
@@ -126,7 +130,7 @@ def compact_window_rounds(st, ctx, handlers, make_handlers, run_rounds,
         # Padding/clone lanes must never pop: force them event-free.
         evbuf_c = evbuf_c._replace(
             kind=jnp.where(lane_pad[None, :], K_NONE, evbuf_c.kind),
-            time=jnp.where(lane_pad[None, :], I64_MAX, evbuf_c.time),
+            t32=jnp.where(lane_pad[None, :], I32_FREE, evbuf_c.t32),
         )
         st_c = st._replace(evbuf=evbuf_c, outbox=outbox_c, model=model_c,
                            cpu_busy=busy_c)

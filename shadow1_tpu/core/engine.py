@@ -233,7 +233,12 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     the dead passes cuts the round cost correspondingly (handlers draw RNG
     and advance counters only where masked, so an all-false pass is a
     no-op by construction and skipping it is exact)."""
-    evbuf, ev = pop_until(st.evbuf, win_end, extract=ctx.params.pop_extract)
+    if ctx.params.pop_impl == "pallas":
+        from shadow1_tpu.core.popk import pop_until_fused
+
+        evbuf, ev = pop_until_fused(st.evbuf, win_end)
+    else:
+        evbuf, ev = pop_until(st.evbuf, win_end, extract=ctx.params.pop_extract)
     st = st._replace(evbuf=evbuf)
     m = st.metrics
     n_down = jnp.zeros((), jnp.int64)
@@ -299,21 +304,25 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.nd
 
     fmask, fsrc, fdst = flat(mask), flat(src), flat(ob.dst)
     fdst_safe = jnp.where(fmask, fdst, 0)
+    # The i32 outbox planes widen once here, at window granularity
+    # (core/outbox.py layout note); fctr is exact below 2**31 pkts/host.
+    fdep = flat(ob.abs_depart())
+    fctr = flat(ob.ctr).astype(jnp.int64)
     vs = ctx.host_vertex[fsrc]
     vd = ctx.host_vertex[fdst_safe]
-    arrival = flat(ob.depart) + ctx.lat_vv[vs, vd]
+    arrival = fdep + ctx.lat_vv[vs, vd]
     if ctx.has_jitter:
         # Per-packet edge jitter in [-J, +J] (reference: topology edge
         # jitter attribute); J < lat so the conservative window holds.
         jit = ctx.jitter_vv[vs, vd]
-        jbits = rng.bits_v(ctx.key, R_JITTER, fsrc, flat(ob.ctr))
+        jbits = rng.bits_v(ctx.key, R_JITTER, fsrc, fctr)
         arrival = arrival + rng.randint(jbits, 2 * jit + 1).astype(jnp.int64) - jit
-    bits = rng.bits_v(ctx.key, R_LOSS, fsrc, flat(ob.ctr))
+    bits = rng.bits_v(ctx.key, R_LOSS, fsrc, fctr)
     # Integer Bernoulli on precomputed thresholds (rng.prob_threshold) —
     # shared with the CPU oracle, backend-exact by construction.
     lost = fmask & rng.uniform_lt(bits, ctx.loss_thr_vv[vs, vd])
     keep = fmask & ~lost
-    tb = packet_tb(fsrc.astype(jnp.int64), flat(ob.ctr))
+    tb = packet_tb(fsrc.astype(jnp.int64), fctr)
     fp = FlatPackets(
         dst=fdst_safe, arrival=arrival, tb=tb, kind=flat(ob.kind), p=flat(ob.p),
         keep=keep,
@@ -407,18 +416,28 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     When ``params.compact_cap`` is set (and ``make_handlers`` provided),
     sparse windows run their rounds on a gathered active-host bucket
     (core/compact.py) — bit-identical results, narrow tensors."""
+    from shadow1_tpu.core.events import push_impl_ctx, rebase
+
     win_end = st.win_start + ctx.window
     if pre_window is not None:
         st = pre_window(st, ctx, win_end)
+    # Advance the i32 pop-key epoch to this window's start (core/events.py:
+    # the round loop below runs i64-free; pre_window and last window's
+    # delivery write absolute times only, repaired here).
+    st = st._replace(evbuf=rebase(st.evbuf, st.win_start))
     ccap = ctx.params.compact_cap
-    if ccap and ccap < ctx.n_hosts and make_handlers is not None:
-        from shadow1_tpu.core.compact import compact_window_rounds
+    # push_impl scopes over the round tracing: every handler-layer
+    # push_local/push_back below dispatches to the selected implementation
+    # (trace-time — see events.push_impl_ctx).
+    with push_impl_ctx(ctx.params.push_impl):
+        if ccap and ccap < ctx.n_hosts and make_handlers is not None:
+            from shadow1_tpu.core.compact import compact_window_rounds
 
-        st, cap_hit = compact_window_rounds(
-            st, ctx, handlers, make_handlers, run_rounds, win_end, ccap
-        )
-    else:
-        st, cap_hit = run_rounds(st, ctx, handlers, win_end)
+            st, cap_hit = compact_window_rounds(
+                st, ctx, handlers, make_handlers, run_rounds, win_end, ccap
+            )
+        else:
+            st, cap_hit = run_rounds(st, ctx, handlers, win_end)
     st = deliver_window(st, ctx, exchange)
     m = st.metrics
     return st._replace(

@@ -4,7 +4,7 @@ The reference gives every host a binary-heap event queue and a locked async
 queue for cross-thread pushes (src/main/core/scheduler/*,
 src/main/utility/priority-queue.c). Here all H queues live in one set of
 fixed-capacity SoA tensors ``[C, H]`` (slot-major, host-minor — see
-core/dense.py for why); pop-min is a pair of masked min-reductions, local
+core/dense.py for why); pop-min is a chain of masked min-reductions, local
 push writes the first free slot, and cross-host delivery is a sorted batch
 merge performed once per conservative window (SURVEY §7.1).
 
@@ -15,18 +15,36 @@ the host's own monotone counter, delivered packets use
 ``consts.packet_tb(src_host, src_pkt_counter)``. Both engines compute the
 same keys, so event order is engine-independent.
 
-TPU notes: every update is dense (one-hot + where, or a sort + segment
-gather) — no dynamic-index scatters, no per-slot ``argmin``/``cumsum`` in
-the round path (all measured slow on the chip; core/dense.py). Pop-min
-exploits that the (time, tb) key pair is UNIQUE per host — tb values never
-repeat within a host (local pushes consume a monotone counter; packet tbs
-embed the unique (src, src_ctr); the two ranges are disjoint via
+int32 round path (round-5 rewrite): the chip has no native int64 — every
+i64 op is a 3-6x-cost emulation (docs/PERF.md) — and the inner round loop
+used to run ~15 full-plane i64 passes per pop/push. The buffer therefore
+carries the pop keys twice:
+
+* ``time``  i64 [C, H] — the authoritative absolute event time, written on
+  push/delivery, READ ONLY at window granularity (rebase, pre_window);
+* ``t32``   i32 [C, H] — ``clamp(time - epoch, 0, I32_HORIZON)`` where
+  ``epoch`` advances to the window start each window (``rebase``). Pop
+  eligibility/ordering runs entirely on t32: exact for every eligible
+  event because eligible means ``time < win_end = epoch + W`` and the
+  engine validates ``W < 2**31`` ns, so eligible rebased times never
+  clamp; far-future events saturate at I32_HORIZON ≥ W and stay
+  ineligible until the epoch catches up;
+* ``tb_hi``/``tb_lo`` i32 [C, H] — the i64 tie-break split into an
+  order-preserving (hi, lo) pair (``lo`` is sign-flipped so SIGNED i32
+  comparison matches the unsigned low-word order). Pop's tie-break is a
+  2-step lexicographic min over these planes: no i64 anywhere per round.
+
+Pop-min exploits that the (time, tb) key pair is UNIQUE per host — tb
+values never repeat within a host (local pushes consume a monotone counter;
+packet tbs embed the unique (src, src_ctr); the two ranges are disjoint via
 TB_PACKET_BASE) — so "the" minimum slot is an equality one-hot against the
-reduced (min-time, min-tb) pair, and payload extraction is a masked sum.
+reduced min keys, and payload extraction is a masked sum. No dynamic
+scatters, no per-slot argmin/cumsum in the round path (core/dense.py).
 """
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple
 
 import jax
@@ -35,33 +53,112 @@ import jax.numpy as jnp
 from shadow1_tpu.consts import K_NONE, NP
 from shadow1_tpu.core.dense import extract_col, first_true
 
+# Trace-time push-implementation selector (EngineParams.push_impl). Handlers
+# throughout the model layers call push_local/push_back directly, so the
+# engine scopes this around its window-step tracing instead of threading an
+# argument through every handler signature. Tracing is single-threaded
+# Python, so a plain module global scoped by the context manager is exact.
+_PUSH_IMPL = "xla"
+
+
+@contextlib.contextmanager
+def push_impl_ctx(impl: str):
+    global _PUSH_IMPL
+    prev, _PUSH_IMPL = _PUSH_IMPL, impl
+    try:
+        yield
+    finally:
+        _PUSH_IMPL = prev
+
 I64_MAX = jnp.iinfo(jnp.int64).max
+I32_MAX = jnp.iinfo(jnp.int32).max
+# Free/ineligible sentinel for the t32 plane; live far-future events clamp
+# to I32_HORIZON. Both are ≥ any valid until32 (window < 2**31 — validated
+# by the engine), so neither can pop.
+I32_FREE = I32_MAX
+I32_HORIZON = I32_MAX - 1
+_SIGN = jnp.int32(-0x80000000)  # == 1 << 31 as a signed bit pattern
+
+
+def tb_split(tb) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """i64 tie-break → (hi, lo) i32 planes, SIGNED-order-preserving.
+
+    tb is always ≥ 0 and < 2**62 (consts.packet_tb / self_ctr), so
+    hi = tb >> 32 fits positive i32 and orders first; lo is the low 32 bits
+    with the sign bit flipped so signed i32 comparison equals unsigned
+    low-word comparison."""
+    hi = (tb >> 32).astype(jnp.int32)
+    lo = (tb & 0xFFFFFFFF).astype(jnp.uint32).astype(jnp.int32) ^ _SIGN
+    return hi, lo
+
+
+def tb_join(hi, lo) -> jnp.ndarray:
+    """Inverse of tb_split."""
+    lo_u = (lo ^ _SIGN).astype(jnp.uint32).astype(jnp.int64)
+    return (hi.astype(jnp.int64) << 32) | lo_u
+
+
+def _t32_of(time, epoch) -> jnp.ndarray:
+    """Rebased saturating pop key for absolute time(s) ≥ epoch."""
+    return jnp.clip(time - epoch, 0, I32_HORIZON).astype(jnp.int32)
 
 
 class EventBuf(NamedTuple):
-    time: jnp.ndarray      # i64 [C, H]
-    tb: jnp.ndarray        # i64 [C, H] tie-break key
+    """Every [C, H] plane is i32 — the chip has no native i64, and this is
+    also the precondition for the Pallas fused-pop kernel (core/popk.py).
+    Absolute event times live as a tb_split-encoded (hi, lo) pair,
+    reassembled only at window granularity (rebase, pre_window)."""
+
+    time_hi: jnp.ndarray   # i32 [C, H] absolute time, high word
+    time_lo: jnp.ndarray   # i32 [C, H] absolute time, low word (sign-flip)
+    t32: jnp.ndarray       # i32 [C, H] rebased pop key (I32_FREE = empty)
+    tb_hi: jnp.ndarray     # i32 [C, H] tie-break high word
+    tb_lo: jnp.ndarray     # i32 [C, H] tie-break low word (sign-flipped)
     kind: jnp.ndarray      # i32 [C, H] (K_NONE = free slot)
     p: jnp.ndarray         # i32 [NP, C, H] payload columns
     self_ctr: jnp.ndarray  # i64 [H] counter for locally-pushed tb keys
+    epoch: jnp.ndarray     # i64 scalar — t32 = clamp(time - epoch)
+
+    def abs_time(self) -> jnp.ndarray:
+        """i64 [C, H] absolute times (window-granularity readers only)."""
+        return tb_join(self.time_hi, self.time_lo)
 
 
 class Popped(NamedTuple):
     mask: jnp.ndarray   # bool [H] — host had an eligible event this round
-    time: jnp.ndarray   # i64 [H]
+    time: jnp.ndarray   # i64 [H] absolute
     kind: jnp.ndarray   # i32 [H] (K_NONE where ~mask)
     p: jnp.ndarray      # i32 [NP, H]
     tb: jnp.ndarray     # i64 [H] original tie-break (for cpu-model requeue)
 
 
 def evbuf_init(n_hosts: int, cap: int) -> EventBuf:
+    thi, tlo = tb_split(jnp.asarray(I64_MAX, jnp.int64))
     return EventBuf(
-        time=jnp.full((cap, n_hosts), I64_MAX, jnp.int64),
-        tb=jnp.zeros((cap, n_hosts), jnp.int64),
+        time_hi=jnp.full((cap, n_hosts), thi, jnp.int32),
+        time_lo=jnp.full((cap, n_hosts), tlo, jnp.int32),
+        t32=jnp.full((cap, n_hosts), I32_FREE, jnp.int32),
+        tb_hi=jnp.zeros((cap, n_hosts), jnp.int32),
+        tb_lo=jnp.zeros((cap, n_hosts), jnp.int32),
         kind=jnp.full((cap, n_hosts), K_NONE, jnp.int32),
         p=jnp.zeros((NP, cap, n_hosts), jnp.int32),
         self_ctr=jnp.zeros(n_hosts, jnp.int64),
+        epoch=jnp.zeros((), jnp.int64),
     )
+
+
+def rebase(buf: EventBuf, epoch) -> EventBuf:
+    """Advance the t32 plane's epoch (once per window, off the round path).
+
+    Recomputes t32 from the authoritative absolute times — this is also
+    what makes window-end ``deliver_batch`` and pre-window event rewrites
+    free to skip t32 maintenance: any staleness is repaired here before the
+    next round loop reads it."""
+    epoch = jnp.asarray(epoch, jnp.int64)
+    t32 = jnp.where(
+        buf.kind != K_NONE, _t32_of(buf.abs_time(), epoch), I32_FREE
+    )
+    return buf._replace(t32=t32, epoch=epoch)
 
 
 def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarray]:
@@ -70,12 +167,22 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
     Returns (buf, overflow_mask). Overflowing events are dropped and must be
     surfaced as a metric — capacity is an experiment knob (SURVEY §7.3.2).
     """
+    if _PUSH_IMPL == "pallas":
+        from shadow1_tpu.core.popk import push_local_fused
+
+        return push_local_fused(buf, mask, time, kind, p)
     has_free, first = first_true(buf.kind == K_NONE)
     ok = mask & has_free
     w = first & ok[None, :]
+    time = jnp.asarray(time, jnp.int64)
+    thi, tlo = tb_split(time)
+    hi, lo = tb_split(buf.self_ctr)
     buf = buf._replace(
-        time=jnp.where(w, jnp.asarray(time, jnp.int64)[None, :], buf.time),
-        tb=jnp.where(w, buf.self_ctr[None, :], buf.tb),
+        time_hi=jnp.where(w, thi[None, :], buf.time_hi),
+        time_lo=jnp.where(w, tlo[None, :], buf.time_lo),
+        t32=jnp.where(w, _t32_of(time, buf.epoch)[None, :], buf.t32),
+        tb_hi=jnp.where(w, hi[None, :], buf.tb_hi),
+        tb_lo=jnp.where(w, lo[None, :], buf.tb_lo),
         kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
         p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
         self_ctr=buf.self_ctr + ok.astype(jnp.int64),
@@ -90,37 +197,61 @@ def push_back(buf: EventBuf, mask, time, tb, kind, p) -> tuple[EventBuf, jnp.nda
     past the window boundary (docs/SEMANTICS.md §cpu): the event re-enters
     at (eff_time, original tb), so its order among same-time events is
     preserved. Does not advance self_ctr."""
+    if _PUSH_IMPL == "pallas":
+        from shadow1_tpu.core.popk import push_back_fused
+
+        return push_back_fused(buf, mask, time, tb, kind, p)
     has_free, first = first_true(buf.kind == K_NONE)
     ok = mask & has_free
     w = first & ok[None, :]
+    time = jnp.asarray(time, jnp.int64)
+    thi, tlo = tb_split(time)
+    hi, lo = tb_split(jnp.asarray(tb, jnp.int64))
     buf = buf._replace(
-        time=jnp.where(w, jnp.asarray(time, jnp.int64)[None, :], buf.time),
-        tb=jnp.where(w, jnp.asarray(tb, jnp.int64)[None, :], buf.tb),
+        time_hi=jnp.where(w, thi[None, :], buf.time_hi),
+        time_lo=jnp.where(w, tlo[None, :], buf.time_lo),
+        t32=jnp.where(w, _t32_of(time, buf.epoch)[None, :], buf.t32),
+        tb_hi=jnp.where(w, hi[None, :], buf.tb_hi),
+        tb_lo=jnp.where(w, lo[None, :], buf.tb_lo),
         kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
         p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
     )
     return buf, mask & ~has_free
 
 
+def until32(buf: EventBuf, until) -> jnp.ndarray:
+    """Rebased eligibility bound. Exact when until - epoch <= I32_HORIZON
+    = 2**31 - 2 (the engine's window-size validation guarantees it for
+    win_end bounds: window < 2**31 - 1, config/compiled.py)."""
+    return jnp.clip(until - buf.epoch, 0, I32_HORIZON).astype(jnp.int32)
+
+
 def pop_until(buf: EventBuf, until, extract: str = "sum") -> tuple[EventBuf, Popped]:
     """Per-host pop of the minimum-(time, tb) event with time < until.
 
-    Two min-reductions over the slot (sublane) axis + an equality one-hot;
-    exact because (time, tb) is unique per host (module docstring).
+    A 3-step lexicographic masked min over the slot (sublane) axis — t32,
+    then tb_hi among time-ties, then tb_lo — ending in an equality one-hot;
+    exact because (time, tb) is unique per host (module docstring). All
+    i32: the only i64 work is the [H]-vector reconstruction of the popped
+    absolute time/tb.
 
     ``extract`` selects how kind/payload leave the buffer — "sum" (masked
     sum over the one-hot) or "gather" (one-hot → index → take_along_axis).
     Both are exact; which is faster is a backend/layout question
     (EngineParams.pop_extract, docs/PERF.md round-5)."""
     assert extract in ("sum", "gather"), f"bad pop_extract {extract!r}"
-    elig = (buf.kind != K_NONE) & (buf.time < until)
-    t_masked = jnp.where(elig, buf.time, I64_MAX)
+    u32 = until32(buf, until)
+    elig = (buf.kind != K_NONE) & (buf.t32 < u32)
+    t_masked = jnp.where(elig, buf.t32, I32_FREE)
     min_t = t_masked.min(axis=0)
-    mask = elig.any(axis=0)
+    mask = min_t < u32
     tie = elig & (t_masked == min_t[None, :])
-    tb_masked = jnp.where(tie, buf.tb, I64_MAX)
-    min_tb = tb_masked.min(axis=0)
-    sel = tie & (tb_masked == min_tb[None, :])      # one-hot per active host
+    hi_masked = jnp.where(tie, buf.tb_hi, I32_MAX)
+    min_hi = hi_masked.min(axis=0)
+    tie2 = tie & (hi_masked == min_hi[None, :])
+    lo_masked = jnp.where(tie2, buf.tb_lo, I32_MAX)
+    min_lo = lo_masked.min(axis=0)
+    sel = tie2 & (lo_masked == min_lo[None, :])    # one-hot per active host
     if extract == "gather":
         from shadow1_tpu.core.dense import first_true_idx, get_col
 
@@ -132,20 +263,20 @@ def pop_until(buf: EventBuf, until, extract: str = "sum") -> tuple[EventBuf, Pop
         pay = extract_col(sel, buf.p)
     ev = Popped(
         mask=mask,
-        time=jnp.where(mask, min_t, 0),
+        time=jnp.where(mask, buf.epoch + min_t.astype(jnp.int64), 0),
         kind=kind,
         p=pay,
-        tb=jnp.where(mask, min_tb, 0),
+        tb=jnp.where(mask, tb_join(min_hi, min_lo), 0),
     )
     buf = buf._replace(
         kind=jnp.where(sel, K_NONE, buf.kind),
-        time=jnp.where(sel, I64_MAX, buf.time),
+        t32=jnp.where(sel, I32_FREE, buf.t32),
     )
     return buf, ev
 
 
 def any_eligible(buf: EventBuf, until) -> jnp.ndarray:
-    return ((buf.kind != K_NONE) & (buf.time < until)).any()
+    return ((buf.kind != K_NONE) & (buf.t32 < until32(buf, until))).any()
 
 
 def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf, jnp.ndarray]:
@@ -162,6 +293,10 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     (time, tb) keys, so it is engine- and layout-independent.
     Returns (buf, n_overflow). ``p`` is [NP, N].
 
+    Runs at window granularity only, so it writes the authoritative i64
+    time plane and leaves t32 stale — the window-start ``rebase`` repairs
+    it before any round reads it.
+
     Overflow-victim selection is layout-defined: when a destination's free
     slots run out, which packets drop depends on flat source order (since
     the [C, H] rewrite: slot-major), so it differs across engines and
@@ -172,12 +307,12 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     TPU tuning: the sort key packs (dst, flat index) into one integer so an
     *unstable* single-key sort is deterministic (keys are distinct and the
     packing preserves source order within a destination); segment bounds
-    come from one H+1-point searchsorted; the 15 payload rows (time/tb
-    split into i32 halves, kind, p) ride one stacked gather instead of
-    four. This runs once per window, so its cumsum over the slot axis is
-    off the round path.
+    come from one H+1-point searchsorted; the 15 payload rows (time split
+    into i32 halves, the pre-split tb planes, kind, p) ride one stacked
+    gather instead of four. This runs once per window, so its cumsum over
+    the slot axis is off the round path.
     """
-    cap, n_hosts = buf.time.shape
+    cap, n_hosts = buf.kind.shape
     n = dst.shape[0]
     nb = max((n - 1).bit_length(), 1)
     wide = (n_hosts + 1) << nb > 2**31 - 1
@@ -193,16 +328,20 @@ def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf
     take = free & (free_rank < n_in[None, :])                # slot receives one
     src = jnp.minimum(seg[:-1][None, :] + free_rank, n - 1)
     oidx = (key_s & ((1 << nb) - 1)).astype(jnp.int32)[src]  # [C, H] flat idx
+    thi, tlo = tb_split(jnp.asarray(time, jnp.int64))
+    bhi, blo = tb_split(jnp.asarray(tb, jnp.int64))
     stacked = jnp.concatenate(
         [
-            jnp.stack([_lo(time), _hi(time), _lo(tb), _hi(tb), kind]),
+            jnp.stack([thi, tlo, bhi, blo, kind]),
             p,
         ]
     )                                                        # [5+NP, N] i32
     g = stacked[:, oidx]                                     # [5+NP, C, H]
     buf = buf._replace(
-        time=jnp.where(take, _join(g[0], g[1]), buf.time),
-        tb=jnp.where(take, _join(g[2], g[3]), buf.tb),
+        time_hi=jnp.where(take, g[0], buf.time_hi),
+        time_lo=jnp.where(take, g[1], buf.time_lo),
+        tb_hi=jnp.where(take, g[2], buf.tb_hi),
+        tb_lo=jnp.where(take, g[3], buf.tb_lo),
         kind=jnp.where(take, g[4], buf.kind),
         p=jnp.where(take[None], g[5:], buf.p),
     )

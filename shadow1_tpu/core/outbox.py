@@ -9,7 +9,14 @@ gather, loss draws) plus the destination scatter once per window — and, when
 sharded, exactly one all_to_all per window over ICI (SURVEY §2.5).
 
 Layout: slot-major, host-minor ([P, H]; payload [NP, P, H]) — see
-core/dense.py for the tiling rationale.
+core/dense.py for the tiling rationale. All [P, H] planes are i32 (the chip
+has no native i64; docs/PERF.md): departure times ride the same
+order-preserving (hi, lo) split as the event buffer (core/events.py
+tb_split), joined once per window in route_outbox; the per-packet counter
+plane holds the low 32 bits of the i64 ``pkt_ctr`` lifetime counter —
+exact while no single host sends ≥ 2**31 packets in one run, which is far
+outside the design envelope (the largest ladder rung totals 33M packets
+across 5,000 hosts).
 """
 
 from __future__ import annotations
@@ -20,24 +27,31 @@ import jax.numpy as jnp
 
 from shadow1_tpu.consts import NP
 from shadow1_tpu.core.dense import set_col
+from shadow1_tpu.core.events import tb_join, tb_split
 
 
 class Outbox(NamedTuple):
-    dst: jnp.ndarray      # i32 [P, H]
-    kind: jnp.ndarray     # i32 [P, H] event kind to deliver at dst
-    depart: jnp.ndarray   # i64 [P, H] time the packet leaves the src NIC
-    ctr: jnp.ndarray      # i64 [P, H] per-src lifetime packet counter
-    p: jnp.ndarray        # i32 [NP, P, H]
-    cnt: jnp.ndarray      # i32 [H] entries used this window
-    pkt_ctr: jnp.ndarray  # i64 [H] lifetime per-src packet counter
+    dst: jnp.ndarray        # i32 [P, H]
+    kind: jnp.ndarray       # i32 [P, H] event kind to deliver at dst
+    depart_hi: jnp.ndarray  # i32 [P, H] src-NIC departure time, high word
+    depart_lo: jnp.ndarray  # i32 [P, H] low word (sign-flipped; tb_split)
+    ctr: jnp.ndarray        # i32 [P, H] per-src packet counter (low word)
+    p: jnp.ndarray          # i32 [NP, P, H]
+    cnt: jnp.ndarray        # i32 [H] entries used this window
+    pkt_ctr: jnp.ndarray    # i64 [H] lifetime per-src packet counter
+
+    def abs_depart(self) -> jnp.ndarray:
+        """i64 [P, H] departure times (window-granularity readers only)."""
+        return tb_join(self.depart_hi, self.depart_lo)
 
 
 def outbox_init(n_hosts: int, cap: int) -> Outbox:
     return Outbox(
         dst=jnp.zeros((cap, n_hosts), jnp.int32),
         kind=jnp.zeros((cap, n_hosts), jnp.int32),
-        depart=jnp.zeros((cap, n_hosts), jnp.int64),
-        ctr=jnp.zeros((cap, n_hosts), jnp.int64),
+        depart_hi=jnp.zeros((cap, n_hosts), jnp.int32),
+        depart_lo=jnp.zeros((cap, n_hosts), jnp.int32),
+        ctr=jnp.zeros((cap, n_hosts), jnp.int32),
         p=jnp.zeros((NP, cap, n_hosts), jnp.int32),
         cnt=jnp.zeros(n_hosts, jnp.int32),
         pkt_ctr=jnp.zeros(n_hosts, jnp.int64),
@@ -53,15 +67,25 @@ def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.n
 
     Callers that cannot tolerate drops (TCP) must check ``outbox_space``
     first and defer to the next window instead (K_TX_RESUME). Dense one-hot
-    write — no scatter (core/dense.py). ``p`` is [NP, H].
+    write — no scatter (core/dense.py). ``p`` is [NP, H]. Dispatches to the
+    fused Pallas kernel under EngineParams.push_impl="pallas"
+    (events.push_impl_ctx scope, core/popk.py).
     """
+    from shadow1_tpu.core.events import _PUSH_IMPL
+
+    if _PUSH_IMPL == "pallas":
+        from shadow1_tpu.core.popk import outbox_append_fused
+
+        return outbox_append_fused(ob, mask, dst, kind, depart, p)
     cap = ob.dst.shape[0]
     ok = mask & (ob.cnt < cap)
+    dhi, dlo = tb_split(jnp.asarray(depart, jnp.int64))
     ob = ob._replace(
         dst=set_col(ob.dst, ob.cnt, dst, ok),
         kind=set_col(ob.kind, ob.cnt, kind, ok),
-        depart=set_col(ob.depart, ob.cnt, depart, ok),
-        ctr=set_col(ob.ctr, ob.cnt, ob.pkt_ctr, ok),
+        depart_hi=set_col(ob.depart_hi, ob.cnt, dhi, ok),
+        depart_lo=set_col(ob.depart_lo, ob.cnt, dlo, ok),
+        ctr=set_col(ob.ctr, ob.cnt, ob.pkt_ctr.astype(jnp.int32), ok),
         p=set_col(ob.p, ob.cnt, p, ok),
         cnt=ob.cnt + ok.astype(jnp.int32),
         pkt_ctr=ob.pkt_ctr + ok.astype(jnp.int64),

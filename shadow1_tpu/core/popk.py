@@ -1,0 +1,309 @@
+"""Pallas fused pop-min kernel — the event-buffer pop in ONE memory pass.
+
+The XLA pop (core/events.py pop_until) lowers to ~12 full-plane HBM passes
+(eligibility, three masked mins with their broadcasts/compares, the one-hot
+extraction, the clears); on-chip each [C, H] pass costs ~50-95 us at rung-3
+shape and the composite measured ~1.35 ms/round (tools/roundprobe.py,
+docs/PERF.md round-5). The whole computation is a per-lane (per-host)
+reduction chain over the sublane (slot) axis with NO cross-lane traffic —
+exactly the shape a fused VMEM kernel wants: read each plane once, keep
+every intermediate in registers/VMEM, write the two updated planes and the
+[H]-vector results once.
+
+Semantics are IDENTICAL to events.pop_until(extract="sum") — same
+lexicographic (t32, tb_hi, tb_lo) masked-min chain, same equality one-hot
+(exact: the key triple is unique per host, events.py module docstring),
+same masked-sum extraction — asserted bit-equal in tests/test_events.py
+and selectable per-run via EngineParams.pop_impl = "pallas".
+
+Grid: 1-D over lane (host) tiles; each program instance sees every slot of
+its host tile ([C, BH] blocks), so the reduction never crosses program
+instances. The lane tile shrinks as ev_cap grows to hold the block set
+(keys + NP payload planes) under the ~16 MB VMEM budget. The updated
+t32/kind planes alias their inputs (in-place update, no spare HBM copy).
+
+Reference anchor: this kernel is the batched analogue of the per-host
+binary-heap pop in the reference's worker loop
+(src/main/core/scheduler/scheduler.c runNextEvent path,
+src/main/utility/priority-queue.c).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from shadow1_tpu.consts import K_NONE, NP
+from shadow1_tpu.core import events as ev
+
+
+def _lane_tile(cap: int, planes: int) -> int:
+    """Lane-tile width holding ``planes`` i32 [cap, BH] blocks in ~8 MB of
+    VMEM. The minimum useful tile is one lane group (128); a cap so large
+    that even 128 lanes blow the budget is rejected loudly instead of
+    silently compiling an over-VMEM kernel."""
+    budget = 8 * 2**20 // (4 * planes * cap)
+    if budget < 128:
+        raise ValueError(
+            f"ev_cap={cap} needs {4 * planes * cap * 128 / 2**20:.1f} MB "
+            "per 128-lane tile — beyond the fused-kernel VMEM budget; use "
+            "pop_impl/push_impl='xla' for caps this deep"
+        )
+    return min(1 << (budget.bit_length() - 1), 2048)
+
+
+def _pop_kernel(until_ref, t32_ref, hi_ref, lo_ref, kind_ref, p_ref,
+                t32o_ref, kindo_ref, mt_ref, mhi_ref, mlo_ref, ko_ref,
+                po_ref):
+    u = until_ref[0]
+    t = t32_ref[:, :]                                   # [C, BH] i32
+    k = kind_ref[:, :]
+    elig = (k != K_NONE) & (t < u)
+    tm = jnp.where(elig, t, ev.I32_FREE)
+    mint = tm.min(axis=0, keepdims=True)                # [1, BH]
+    tie = elig & (tm == mint)
+    him = jnp.where(tie, hi_ref[:, :], ev.I32_MAX)
+    minhi = him.min(axis=0, keepdims=True)
+    tie2 = tie & (him == minhi)
+    lom = jnp.where(tie2, lo_ref[:, :], ev.I32_MAX)
+    minlo = lom.min(axis=0, keepdims=True)
+    sel = tie2 & (lom == minlo)                         # one-hot per host
+    t32o_ref[:, :] = jnp.where(sel, ev.I32_FREE, t)
+    kindo_ref[:, :] = jnp.where(sel, K_NONE, k)
+    mt_ref[:, :] = mint
+    mhi_ref[:, :] = minhi
+    mlo_ref[:, :] = minlo
+    ko_ref[:, :] = jnp.where(sel, k, 0).sum(axis=0, keepdims=True)
+    po_ref[:, :, :] = jnp.where(sel[None], p_ref[:, :, :], 0).sum(
+        axis=1, keepdims=True
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pop_call(t32, tb_hi, tb_lo, kind, p, u32, *, interpret=False):
+    cap, h = kind.shape
+    bh = _lane_tile(cap, planes=6 + NP)
+    grid = (pl.cdiv(h, bh),)
+    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
+    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
+    out_shapes = (
+        jax.ShapeDtypeStruct((cap, h), jnp.int32),   # t32'
+        jax.ShapeDtypeStruct((cap, h), jnp.int32),   # kind'
+        jax.ShapeDtypeStruct((1, h), jnp.int32),     # min_t
+        jax.ShapeDtypeStruct((1, h), jnp.int32),     # min_hi
+        jax.ShapeDtypeStruct((1, h), jnp.int32),     # min_lo
+        jax.ShapeDtypeStruct((1, h), jnp.int32),     # kind_out
+        jax.ShapeDtypeStruct((NP, 1, h), jnp.int32),  # p_out
+    )
+    return pl.pallas_call(
+        _pop_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # until32 (1,)
+            blk2, blk2, blk2, blk2,
+            pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i)),
+        ],
+        out_specs=(
+            blk2, blk2, vec, vec, vec, vec,
+            pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i)),
+        ),
+        out_shape=out_shapes,
+        input_output_aliases={1: 0, 4: 1},           # t32, kind in-place
+        interpret=interpret,
+    )(jnp.asarray(u32).reshape(1), t32, tb_hi, tb_lo, kind, p)
+
+
+def _resolve_interpret(interpret):
+    """Mosaic compiles only for TPU; every other backend (the CPU test
+    platform, virtual device meshes) runs the kernels in interpret mode.
+    Resolved here so call sites cannot forget the incantation."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def pop_until_fused(buf: ev.EventBuf, until, *,
+                    interpret: bool | None = None) -> tuple[ev.EventBuf, ev.Popped]:
+    """Drop-in fused replacement for events.pop_until (extract="sum")."""
+    interpret = _resolve_interpret(interpret)
+    u32 = ev.until32(buf, until)
+    t32o, kindo, mt, mhi, mlo, ko, po = _pop_call(
+        buf.t32, buf.tb_hi, buf.tb_lo, buf.kind, buf.p, u32,
+        interpret=interpret,
+    )
+    mt, mhi, mlo, ko = mt[0], mhi[0], mlo[0], ko[0]
+    mask = mt < u32
+    popped = ev.Popped(
+        mask=mask,
+        time=jnp.where(mask, buf.epoch + mt.astype(jnp.int64), 0),
+        kind=ko,
+        p=po[:, 0, :],
+        tb=jnp.where(mask, ev.tb_join(mhi, mlo), 0),
+    )
+    return buf._replace(t32=t32o, kind=kindo), popped
+
+
+def _push_kernel(maskv_ref, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
+                 thi_ref, tlo_ref, t32_ref, bhi_ref, blo_ref, kind_ref, p_ref,
+                 thi_o, tlo_o, t32_o, bhi_o, blo_o, kind_o, p_o, over_o):
+    k = kind_ref[:, :]                                  # [C, BH]
+    free = k == K_NONE
+    idx = jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
+    cap = k.shape[0]
+    fidx = jnp.where(free, idx, cap).min(axis=0, keepdims=True)  # [1, BH]
+    has = fidx < cap
+    mv = maskv_ref[:, :] != 0
+    ok = mv & has
+    w = free & (idx == fidx) & ok
+    thi_o[:, :] = jnp.where(w, thi_v[:, :], thi_ref[:, :])
+    tlo_o[:, :] = jnp.where(w, tlo_v[:, :], tlo_ref[:, :])
+    t32_o[:, :] = jnp.where(w, t32_v[:, :], t32_ref[:, :])
+    bhi_o[:, :] = jnp.where(w, bhi_v[:, :], bhi_ref[:, :])
+    blo_o[:, :] = jnp.where(w, blo_v[:, :], blo_ref[:, :])
+    kind_o[:, :] = jnp.where(w, kind_v[:, :], k)
+    p_o[:, :, :] = jnp.where(w[None], p_v[:, :, :], p_ref[:, :, :])
+    over_o[:, :] = (mv & ~has).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _push_call(maskv, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
+               thi, tlo, t32, bhi, blo, kind, p, *, interpret=False):
+    cap, h = kind.shape
+    bh = _lane_tile(cap, planes=7 + NP)
+    grid = (pl.cdiv(h, bh),)
+    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
+    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
+    pvec = pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i))
+    pblk = pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i))
+    plane = jax.ShapeDtypeStruct((cap, h), jnp.int32)
+    out_shapes = (
+        plane, plane, plane, plane, plane, plane,
+        jax.ShapeDtypeStruct((NP, cap, h), jnp.int32),
+        jax.ShapeDtypeStruct((1, h), jnp.int32),     # overflow
+    )
+    return pl.pallas_call(
+        _push_kernel,
+        grid=grid,
+        in_specs=[vec, vec, vec, vec, vec, vec, vec, pvec,
+                  blk2, blk2, blk2, blk2, blk2, blk2, pblk],
+        out_specs=(blk2, blk2, blk2, blk2, blk2, blk2, pblk, vec),
+        out_shape=out_shapes,
+        # The seven buffer planes update in place.
+        input_output_aliases={8: 0, 9: 1, 10: 2, 11: 3, 12: 4, 13: 5, 14: 6},
+        interpret=interpret,
+    )(maskv, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
+      thi, tlo, t32, bhi, blo, kind, p)
+
+
+def _push_fused(buf: ev.EventBuf, mask, time, tb, kind, p, *,
+                advance_ctr: bool, interpret: bool | None = None):
+    """Shared body of the fused push_local/push_back (tb = self_ctr or the
+    original tie-break, per events.py semantics)."""
+    interpret = _resolve_interpret(interpret)
+    time = jnp.asarray(time, jnp.int64)
+    thi_v, tlo_v = ev.tb_split(time)
+    bhi_v, blo_v = ev.tb_split(jnp.asarray(tb, jnp.int64))
+    t32_v = ev._t32_of(time, buf.epoch)
+    row = lambda x: jnp.asarray(x, jnp.int32).reshape(1, -1)
+    thi, tlo, t32, bhi, blo, kindo, po, over = _push_call(
+        row(mask), row(thi_v), row(tlo_v), row(t32_v), row(bhi_v),
+        row(blo_v), row(jnp.broadcast_to(jnp.asarray(kind, jnp.int32),
+                                         time.shape)),
+        jnp.asarray(p, jnp.int32)[:, None, :],
+        buf.time_hi, buf.time_lo, buf.t32, buf.tb_hi, buf.tb_lo, buf.kind,
+        buf.p, interpret=interpret,
+    )
+    over = (over[0] != 0) & mask
+    buf = buf._replace(
+        time_hi=thi, time_lo=tlo, t32=t32, tb_hi=bhi, tb_lo=blo,
+        kind=kindo, p=po,
+    )
+    if advance_ctr:
+        buf = buf._replace(
+            self_ctr=buf.self_ctr + (mask & ~over).astype(jnp.int64)
+        )
+    return buf, over
+
+
+def push_local_fused(buf: ev.EventBuf, mask, time, kind, p, *,
+                     interpret: bool | None = None):
+    """Drop-in fused replacement for events.push_local."""
+    return _push_fused(buf, mask, time, buf.self_ctr, kind, p,
+                       advance_ctr=True, interpret=interpret)
+
+
+def push_back_fused(buf: ev.EventBuf, mask, time, tb, kind, p, *,
+                    interpret: bool | None = None):
+    """Drop-in fused replacement for events.push_back."""
+    return _push_fused(buf, mask, time, tb, kind, p,
+                       advance_ctr=False, interpret=interpret)
+
+
+def _obox_kernel(cnt_ref, okv_ref, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
+                 dst_ref, kind_ref, dhi_ref, dlo_ref, ctr_ref, p_ref,
+                 dst_o, kind_o, dhi_o, dlo_o, ctr_o, p_o):
+    cap = dst_ref.shape[0]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (cap,) + cnt_ref.shape[1:], 0)
+    w = (idx == cnt_ref[:, :]) & (okv_ref[:, :] != 0)
+    dst_o[:, :] = jnp.where(w, dst_v[:, :], dst_ref[:, :])
+    kind_o[:, :] = jnp.where(w, kind_v[:, :], kind_ref[:, :])
+    dhi_o[:, :] = jnp.where(w, dhi_v[:, :], dhi_ref[:, :])
+    dlo_o[:, :] = jnp.where(w, dlo_v[:, :], dlo_ref[:, :])
+    ctr_o[:, :] = jnp.where(w, ctr_v[:, :], ctr_ref[:, :])
+    p_o[:, :, :] = jnp.where(w[None], p_v[:, :, :], p_ref[:, :, :])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _obox_call(cnt, okv, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
+               dst, kind, dhi, dlo, ctr, p, *, interpret=False):
+    cap, h = dst.shape
+    bh = _lane_tile(cap, planes=5 + NP)
+    grid = (pl.cdiv(h, bh),)
+    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
+    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
+    pvec = pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i))
+    pblk = pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i))
+    plane = jax.ShapeDtypeStruct((cap, h), jnp.int32)
+    return pl.pallas_call(
+        _obox_kernel,
+        grid=grid,
+        in_specs=[vec, vec, vec, vec, vec, vec, vec, pvec,
+                  blk2, blk2, blk2, blk2, blk2, pblk],
+        out_specs=(blk2, blk2, blk2, blk2, blk2, pblk),
+        out_shape=(plane, plane, plane, plane, plane,
+                   jax.ShapeDtypeStruct((NP, cap, h), jnp.int32)),
+        input_output_aliases={8: 0, 9: 1, 10: 2, 11: 3, 12: 4, 13: 5},
+        interpret=interpret,
+    )(cnt, okv, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
+      dst, kind, dhi, dlo, ctr, p)
+
+
+def outbox_append_fused(ob, mask, dst, kind, depart, p, *,
+                        interpret: bool | None = None):
+    """Drop-in fused replacement for outbox.outbox_append: the write slot is
+    ``cnt[h]`` (not a first-free search), so the kernel is a pure one-hot
+    write pass over the [P, H] planes."""
+    interpret = _resolve_interpret(interpret)
+    cap = ob.dst.shape[0]
+    ok = mask & (ob.cnt < cap)
+    dhi_v, dlo_v = ev.tb_split(jnp.asarray(depart, jnp.int64))
+    row = lambda x: jnp.asarray(x, jnp.int32).reshape(1, -1)
+    h = ob.cnt.shape[0]
+    dsto, kindo, dhio, dloo, ctro, po = _obox_call(
+        row(ob.cnt), row(ok), row(jnp.broadcast_to(jnp.asarray(dst, jnp.int32), (h,))),
+        row(jnp.broadcast_to(jnp.asarray(kind, jnp.int32), (h,))),
+        row(dhi_v), row(dlo_v), row(ob.pkt_ctr.astype(jnp.int32)),
+        jnp.asarray(p, jnp.int32)[:, None, :],
+        ob.dst, ob.kind, ob.depart_hi, ob.depart_lo, ob.ctr, ob.p,
+        interpret=interpret,
+    )
+    ob = ob._replace(
+        dst=dsto, kind=kindo, depart_hi=dhio, depart_lo=dloo, ctr=ctro, p=po,
+        cnt=ob.cnt + ok.astype(jnp.int32),
+        pkt_ctr=ob.pkt_ctr + ok.astype(jnp.int64),
+    )
+    return ob, ok

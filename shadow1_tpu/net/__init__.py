@@ -31,7 +31,7 @@ from shadow1_tpu.consts import (
     SEC,
     WIRE_OVERHEAD,
 )
-from shadow1_tpu.core.events import I64_MAX, push_local
+from shadow1_tpu.core.events import I64_MAX, push_local, tb_split
 from shadow1_tpu.core.outbox import outbox_append
 from shadow1_tpu.net.nic import NicState, ctx_aqm, nic_init, rx_stamp, tx_stamp
 from shadow1_tpu.tcp import tcp as T
@@ -137,26 +137,33 @@ def make_pre_window(ctx):
 
     def pre_window(st, _ctx, win_end):
         buf = st.evbuf
-        cap, h = buf.time.shape
-        sel = (buf.kind == K_PKT) & (buf.time < win_end)
-        kind0, time0 = buf.kind, buf.time
+        cap, h = buf.kind.shape
+        # Absolute times join once per window (the buffer planes are i32 —
+        # core/events.py EventBuf); writes below split back via tb_split.
+        abs_t = buf.abs_time()
+        sel = (buf.kind == K_PKT) & (abs_t < win_end)
+        kind0, time0 = buf.kind, abs_t
         m = st.metrics
         if ctx.has_stop:
             # A stopped host discards arrivals unprocessed (run_round rule);
             # they must not reserve the downlink.
-            down = sel & (buf.time >= ctx.stop_time[None, :])
+            down = sel & (abs_t >= ctx.stop_time[None, :])
             sel = sel & ~down
             kind0 = jnp.where(down, K_NONE, kind0)
             time0 = jnp.where(down, I64_MAX, time0)
             m = m._replace(down_events=m.down_events
                            + down.sum(dtype=jnp.int64))
-        t_key = jnp.where(sel, buf.time, I64_MAX)
-        tb_key = jnp.where(sel, buf.tb, I64_MAX)
+        t_key = jnp.where(sel, abs_t, I64_MAX)
+        # Tie-break ordering over the pre-split (hi, lo) i32 planes
+        # (core/events.py tb_split): lexicographic (time, tb_hi, tb_lo)
+        # equals the (time, tb) i64 order.
+        hi_key = jnp.where(sel, buf.tb_hi, jnp.iinfo(jnp.int32).max)
+        lo_key = jnp.where(sel, buf.tb_lo, jnp.iinfo(jnp.int32).max)
         idx = jnp.broadcast_to(
             jnp.arange(cap, dtype=jnp.int32)[:, None], (cap, h)
         )
-        t_s, _tb_s, idx_s = jax.lax.sort(
-            (t_key, tb_key, idx), dimension=0, num_keys=2
+        t_s, _hi_s, _lo_s, idx_s = jax.lax.sort(
+            (t_key, hi_key, lo_key, idx), dimension=0, num_keys=3
         )
         valid = t_s < I64_MAX
         plen = jnp.take_along_axis(buf.p[4], idx_s, axis=0)
@@ -182,9 +189,12 @@ def make_pre_window(ctx):
             rx_free=free[-1, :],
             rx_bytes=st.model.nic.rx_bytes + wire.sum(axis=0),
         )
+        new_time = jnp.where(vo, ready_o, time0)
+        thi, tlo = tb_split(new_time)
         evbuf = buf._replace(
             kind=jnp.where(vo, K_PKT_DELIVER, kind0),
-            time=jnp.where(vo, ready_o, time0),
+            time_hi=thi,
+            time_lo=tlo,
         )
         return st._replace(
             evbuf=evbuf, model=st.model._replace(nic=nic), metrics=m

@@ -74,26 +74,37 @@ from shadow1_tpu.core.dense import (
     onehot_col,
     set_col,
 )
+from shadow1_tpu.core.events import tb_join, tb_split
 from shadow1_tpu.core.outbox import outbox_append, outbox_space
 from shadow1_tpu.net.nic import ctx_aqm, tx_stamp
 
-# Fields of the TCP state dict, all [H, S] unless noted.
+# Fields of the TCP state dict, all [S, H] unless noted.
 _FIELDS_I32 = (
     "st", "peer_host", "peer_sock",
     "snd_una", "snd_nxt", "rcv_nxt", "app_end",   # seq space (u32 wrap)
     "fin_pend", "cwnd", "ssthresh", "peer_wnd",
     "dupacks", "recover", "ts_seq", "txr",
 )
+# Time-valued fields with i64 SEMANTICS (RTT estimator state, retransmit
+# deadline, RTT-sample stamp — values up to rto_max·backoff / absolute sim
+# time). Stored as order-preserving i32 (hi, lo) plane pairs (core/events.py
+# tb_split): the chip has no native i64, and these planes see a one-hot
+# full-plane write per set (core/dense.py set_col) on the round path.
+# Sock.g/Sock.s and the tcp_flush helpers join/split at the [H]-vector
+# level, so all arithmetic still happens on exact i64 values.
 _FIELDS_I64 = ("srtt", "rttvar", "rto", "rtx_t", "ts_time")
+_I64_SET = frozenset(_FIELDS_I64)
 _FIELDS_BOOL = ("timer_armed", "ts_act")
 
 
 def tcp_init(n_hosts: int, n_socks: int, mq_cap: int, params) -> dict:
+    zhi, zlo = tb_split(jnp.zeros((), jnp.int64))
     d = {}
     for f in _FIELDS_I32:
         d[f] = jnp.zeros((n_socks, n_hosts), jnp.int32)
     for f in _FIELDS_I64:
-        d[f] = jnp.zeros((n_socks, n_hosts), jnp.int64)
+        d[f + "_hi"] = jnp.full((n_socks, n_hosts), zhi, jnp.int32)
+        d[f + "_lo"] = jnp.full((n_socks, n_hosts), zlo, jnp.int32)
     for f in _FIELDS_BOOL:
         d[f] = jnp.zeros((n_socks, n_hosts), bool)
     d["mq_valid"] = jnp.zeros((mq_cap, n_socks, n_hosts), bool)
@@ -114,10 +125,19 @@ class Sock:
         self.mask = mask
 
     def g(self, k):
-        return get_col(self.d[k], jnp.where(self.mask, self.sock, 0))
+        col = jnp.where(self.mask, self.sock, 0)
+        if k in _I64_SET:
+            return tb_join(get_col(self.d[k + "_hi"], col),
+                           get_col(self.d[k + "_lo"], col))
+        return get_col(self.d[k], col)
 
     def s(self, k, val, where=None):
         m = self.mask if where is None else (self.mask & where)
+        if k in _I64_SET:
+            hi, lo = tb_split(jnp.asarray(val, jnp.int64))
+            self.d[k + "_hi"] = set_col(self.d[k + "_hi"], self.sock, hi, m)
+            self.d[k + "_lo"] = set_col(self.d[k + "_lo"], self.sock, lo, m)
+            return
         self.d[k] = set_col(self.d[k], self.sock, val, m)
 
 
@@ -240,6 +260,10 @@ def tcp_flush(st, ctx, mask, sock, now):
     def g(f):
         return get_col(tcp[f], sock_safe)
 
+    def g64(f):
+        return tb_join(get_col(tcp[f + "_hi"], sock_safe),
+                       get_col(tcp[f + "_lo"], sock_safe))
+
     state = g("st")
     sendable = mask & _state_in(state, _SENDABLE)
     snd_una = g("snd_una")
@@ -248,7 +272,7 @@ def tcp_flush(st, ctx, mask, sock, now):
     limit = jnp.minimum(g("cwnd"), g("peer_wnd"))
     rcv_nxt = g("rcv_nxt")
     peer_host, peer_sock = g("peer_host"), g("peer_sock")
-    rto = g("rto")
+    rto = g64("rto")
     mqv, mqe, mqm = g("mq_valid"), g("mq_end"), g("mq_meta")  # [MQ, H]
     is_synrcvd = state == TCP_SYN_RCVD
 
@@ -260,12 +284,12 @@ def tcp_flush(st, ctx, mask, sock, now):
     qlen = ctx.tx_qlen_ns if ctx.has_tx_qlen else None
     now64 = jnp.asarray(now, jnp.int64)
     ts_taken = g("ts_act")
-    rtx_armed = g("rtx_t") != 0
+    rtx_armed = g64("rtx_t") != 0
     lanes = []  # per-lane (sent, depart, seq, length, flags, mend, mmeta)
     n_tx_drop = jnp.zeros((), jnp.int64)
     n_red = jnp.zeros((), jnp.int64)
     ts_seq = g("ts_seq")
-    ts_time = g("ts_time")
+    ts_time = g64("ts_time")
     ts_first = jnp.zeros(H, bool)  # any lane took the RTT sample
     arm_any = jnp.zeros(H, bool)
     for _ in range(B):
@@ -348,8 +372,14 @@ def tcp_flush(st, ctx, mask, sock, now):
         return jnp.where(written[None], new, old)
 
     dstL = [jnp.where(l[0], peer_host, 0) for l in lanes]
-    departL = [l[1] for l in lanes]
-    ctrL = [ob.pkt_ctr + rank[i].astype(jnp.int64) for i in range(B)]
+    # Departure times split to the outbox's i32 (hi, lo) planes at the
+    # [H]-vector level (core/outbox.py layout; lo is sign-flipped but the
+    # one-hot masked-sum merge is sign-agnostic). The ctr plane is the low
+    # word of pkt_ctr (exact below 2**31 pkts/host).
+    depL = [tb_split(l[1]) for l in lanes]
+    dhiL = [d[0] for d in depL]
+    dloL = [d[1] for d in depL]
+    ctrL = [ob.pkt_ctr.astype(jnp.int32) + rank[i] for i in range(B)]
     pL = []
     p1 = pack_meta(sock, peer_sock, 0)
     for (snt, dep, seq, length, flags, mend, mmeta) in lanes:
@@ -366,8 +396,9 @@ def tcp_flush(st, ctx, mask, sock, now):
     ob = ob._replace(
         dst=merge(ob.dst, dstL, jnp.int32),
         kind=jnp.where(written, K_PKT, ob.kind),
-        depart=merge(ob.depart, departL, jnp.int64),
-        ctr=merge(ob.ctr, ctrL, jnp.int64),
+        depart_hi=merge(ob.depart_hi, dhiL, jnp.int32),
+        depart_lo=merge(ob.depart_lo, dloL, jnp.int32),
+        ctr=merge(ob.ctr, ctrL, jnp.int32),
         p=merge(ob.p, pL, jnp.int32),
         cnt=ob.cnt + n_new,
         pkt_ctr=ob.pkt_ctr + n_new.astype(jnp.int64),
@@ -382,8 +413,12 @@ def tcp_flush(st, ctx, mask, sock, now):
     d["snd_nxt"] = set_col(d["snd_nxt"], sock, nxt, mask & adv)
     d["ts_act"] = set_col(d["ts_act"], sock, True, mask & ts_first)
     d["ts_seq"] = set_col(d["ts_seq"], sock, ts_seq, mask & ts_first)
-    d["ts_time"] = set_col(d["ts_time"], sock, ts_time, mask & ts_first)
-    d["rtx_t"] = set_col(d["rtx_t"], sock, now64 + rto, mask & arm_any)
+    tshi, tslo = tb_split(ts_time)
+    d["ts_time_hi"] = set_col(d["ts_time_hi"], sock, tshi, mask & ts_first)
+    d["ts_time_lo"] = set_col(d["ts_time_lo"], sock, tslo, mask & ts_first)
+    rthi, rtlo = tb_split(now64 + rto)
+    d["rtx_t_hi"] = set_col(d["rtx_t_hi"], sock, rthi, mask & arm_any)
+    d["rtx_t_lo"] = set_col(d["rtx_t_lo"], sock, rtlo, mask & arm_any)
     timer_armed0 = get_col(tcp["timer_armed"], sock_safe)
     need_ev = arm_any & ~timer_armed0
     d["timer_armed"] = set_col(d["timer_armed"], sock, True, mask & need_ev)

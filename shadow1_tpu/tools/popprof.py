@@ -1,0 +1,136 @@
+"""Profile the pop/push primitives — name the op the 1.4 ms/round hides in.
+
+    python -m shadow1_tpu.tools.popprof [--iters N] [--hosts H] [--cap C]
+        [--trace DIR]
+
+Round-5 roundprobe finding: EVERY event-buffer primitive (pop, pop_nop,
+push, cycle) costs ~1.35-1.4 ms/iter at [C=256, H=1000] on the chip —
+~1000x above the HBM roofline for the ~40 MB the ops touch, and
+near-identical across probes whose op mix differs. That shape of number
+means a fixed pathology (layout transposes, i64 emulation blowup, or a
+serialized reduction), not bandwidth. This tool (a) dumps the compiled HLO
+for the pop loop so the guilty op is visible by name, and (b) times shape/
+dtype ablations of the same pop program: i32 keys vs i64, cap 64 vs 256,
+payload vs none — attributing the cost to an axis we can engineer away.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--hosts", type=int, default=1000)
+    ap.add_argument("--cap", type=int, default=256)
+    ap.add_argument("--hlo", action="store_true",
+                    help="dump optimized HLO of the i64 pop loop to stdout")
+    ap.add_argument("--allow-cpu", action="store_true")
+    args = ap.parse_args()
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print(json.dumps({"backend": jax.default_backend(), "hosts": args.hosts,
+                      "cap": args.cap, "iters": args.iters}), flush=True)
+    if jax.default_backend() == "cpu" and not args.allow_cpu:
+        print(json.dumps({"error": "cpu backend"}))
+        return 1
+
+    rng = np.random.default_rng(7)
+    iters = args.iters
+
+    def pop_loop(tdt):
+        """The pop_nop reduction skeleton at dtype ``tdt`` for time/tb."""
+        MAX = jnp.iinfo(tdt).max
+
+        def step(carry):
+            t, tb, kind, acc = carry
+            elig = (kind != 0) & (t < MAX // 2)
+            t_masked = jnp.where(elig, t, MAX)
+            min_t = t_masked.min(axis=0)
+            tie = elig & (t_masked == min_t[None, :])
+            tb_masked = jnp.where(tie, tb, MAX)
+            min_tb = tb_masked.min(axis=0)
+            sel = tie & (tb_masked == min_tb[None, :])
+            kind = jnp.where(sel, 0, kind)
+            t = jnp.where(sel, MAX, t)
+            return t, tb, kind, acc + min_t
+
+        def loop(carry, n):
+            return jax.lax.fori_loop(0, n, lambda _, c: step(c), carry)
+
+        return jax.jit(loop, static_argnums=1)
+
+    def seeded(tdt, cap, hosts):
+        t = jnp.asarray(rng.integers(0, 1 << 30, (cap, hosts)), tdt)
+        tb = jnp.asarray(rng.integers(0, 1 << 30, (cap, hosts)), tdt)
+        kind = jnp.ones((cap, hosts), jnp.int32)
+        acc = jnp.zeros(hosts, tdt)
+        return t, tb, kind, acc
+
+    def timeit(name, f, carry):
+        jax.block_until_ready(f(carry, iters))
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(carry, iters))
+        wall = time.perf_counter() - t0
+        print(json.dumps({"probe": name,
+                          "us_per_iter": round(1e6 * wall / iters, 1)}),
+              flush=True)
+
+    H, C = args.hosts, args.cap
+    if args.hlo:
+        f = pop_loop(jnp.int64)
+        lowered = f.lower(seeded(jnp.int64, C, H), iters)
+        print(lowered.compile().as_text()[:20000])
+        return 0
+
+    # Ablation grid: dtype x cap.
+    for tdt, label in ((jnp.int64, "i64"), (jnp.int32, "i32")):
+        for cap in (C, C // 4):
+            f = pop_loop(tdt)
+            timeit(f"pop_nop_{label}_c{cap}", f, seeded(tdt, cap, H))
+
+    # Host-major control: the SAME i64 reduction skeleton with axes swapped
+    # ([H, C], reduce over the minor/lane axis) — the round-3 layout.
+    def pop_loop_hm():
+        MAX = jnp.iinfo(jnp.int64).max
+
+        def step(carry):
+            t, tb, kind, acc = carry
+            elig = (kind != 0) & (t < MAX // 2)
+            t_masked = jnp.where(elig, t, MAX)
+            min_t = t_masked.min(axis=1)
+            tie = elig & (t_masked == min_t[:, None])
+            tb_masked = jnp.where(tie, tb, MAX)
+            min_tb = tb_masked.min(axis=1)
+            sel = tie & (tb_masked == min_tb[:, None])
+            kind = jnp.where(sel, 0, kind)
+            t = jnp.where(sel, MAX, t)
+            return t, tb, kind, acc + min_t
+
+        def loop(carry, n):
+            return jax.lax.fori_loop(0, n, lambda _, c: step(c), carry)
+
+        return jax.jit(loop, static_argnums=1)
+
+    t = jnp.asarray(rng.integers(0, 1 << 30, (H, C)), jnp.int64)
+    tb = jnp.asarray(rng.integers(0, 1 << 30, (H, C)), jnp.int64)
+    kind = jnp.ones((H, C), jnp.int32)
+    acc = jnp.zeros(H, jnp.int64)
+    timeit("pop_nop_hostmajor_i64", pop_loop_hm(), (t, tb, kind, acc))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -58,6 +58,11 @@ def main() -> int:
     ap.add_argument("--allow-cpu", action="store_true",
                     help="run even on the CPU backend (smoke/compile check "
                          "only — CPU timings do not attribute TPU cost)")
+    ap.add_argument("--pallas", action="store_true",
+                    help="phold_win probe: run the engine with "
+                         "pop_impl=push_impl='pallas' (core/popk.py); the "
+                         "primitive-level fused probes are pop_f/push_f/"
+                         "cycle_f/obox_f")
     args = ap.parse_args()
 
     import shadow1_tpu  # noqa: F401
@@ -82,16 +87,24 @@ def main() -> int:
     rng = np.random.default_rng(7)
 
     def seeded_buf(fill: int) -> ev.EventBuf:
-        """A buffer with ``fill`` live events per host at random times."""
+        """A buffer with ``fill`` live events per host at random times.
+
+        Times stay under the i32 rebase horizon (epoch 0) so the seeded
+        keys are exact under the round-5 i32 round path (core/events.py)."""
         buf = ev.evbuf_init(H, C)
-        t = jnp.asarray(rng.integers(0, 1 << 40, (C, H)), jnp.int64)
+        t = jnp.asarray(rng.integers(0, 1 << 30, (C, H)), jnp.int64)
         tb = jnp.asarray(rng.integers(0, 1 << 40, (C, H)), jnp.int64)
+        thi, tlo = ev.tb_split(t)
+        hi, lo = ev.tb_split(tb)
         live = jnp.asarray(np.arange(C)[:, None] < fill, bool)
-        return buf._replace(
-            time=jnp.where(live, t, buf.time),
-            tb=jnp.where(live, tb, buf.tb),
+        buf = buf._replace(
+            time_hi=jnp.where(live, thi, buf.time_hi),
+            time_lo=jnp.where(live, tlo, buf.time_lo),
+            tb_hi=jnp.where(live, hi, buf.tb_hi),
+            tb_lo=jnp.where(live, lo, buf.tb_lo),
             kind=jnp.where(live, 1, buf.kind),
         )
+        return ev.rebase(buf, 0)
 
     def timeit(name, make_step, carry0):
         """us/iter of ``carry = step(carry)`` over ``iters`` fori rounds."""
@@ -111,7 +124,9 @@ def main() -> int:
                           "us_per_iter": round(1e6 * wall / iters, 1)}),
               flush=True)
 
-    until = jnp.int64(1 << 41)                      # everything eligible
+    until = jnp.int64(1 << 30)                      # everything eligible
+    interp = jax.default_backend() != "tpu"         # pallas interpret mode
+    until_i32 = jnp.int32(1 << 30)
 
     for probe in args.probes:
         if probe == "pop":
@@ -124,44 +139,83 @@ def main() -> int:
             timeit("pop", step, seeded_buf(C))
         elif probe == "pop_nop":
             def step(buf):
-                # pop_until minus the payload/kind extraction: the two
-                # min-reductions and the buffer clear only.
-                elig = (buf.kind != 0) & (buf.time < until)
-                t_masked = jnp.where(elig, buf.time, ev.I64_MAX)
+                # pop_until minus the payload/kind extraction: the i32
+                # lexicographic min chain and the buffer clear only.
+                elig = (buf.kind != 0) & (buf.t32 < until_i32)
+                t_masked = jnp.where(elig, buf.t32, ev.I32_FREE)
                 min_t = t_masked.min(axis=0)
                 tie = elig & (t_masked == min_t[None, :])
-                tb_masked = jnp.where(tie, buf.tb, ev.I64_MAX)
-                min_tb = tb_masked.min(axis=0)
-                sel = tie & (tb_masked == min_tb[None, :])
+                hi_masked = jnp.where(tie, buf.tb_hi, ev.I32_MAX)
+                min_hi = hi_masked.min(axis=0)
+                tie2 = tie & (hi_masked == min_hi[None, :])
+                lo_masked = jnp.where(tie2, buf.tb_lo, ev.I32_MAX)
+                min_lo = lo_masked.min(axis=0)
+                sel = tie2 & (lo_masked == min_lo[None, :])
                 return buf._replace(
                     kind=jnp.where(sel, 0, buf.kind),
-                    time=jnp.where(sel, ev.I64_MAX, buf.time),
-                    self_ctr=buf.self_ctr + min_t,
+                    t32=jnp.where(sel, ev.I32_FREE, buf.t32),
+                    self_ctr=buf.self_ctr + min_t.astype(jnp.int64),
                 )
 
             timeit("pop_nop", step, seeded_buf(C))
         elif probe == "pop_gat":
-            from shadow1_tpu.core.dense import first_true_idx, get_col
-
             def step(buf):
-                elig = (buf.kind != 0) & (buf.time < until)
-                t_masked = jnp.where(elig, buf.time, ev.I64_MAX)
-                min_t = t_masked.min(axis=0)
-                mask = elig.any(axis=0)
-                tie = elig & (t_masked == min_t[None, :])
-                tb_masked = jnp.where(tie, buf.tb, ev.I64_MAX)
-                min_tb = tb_masked.min(axis=0)
-                sel = tie & (tb_masked == min_tb[None, :])
-                _, slot = first_true_idx(sel)
-                kind = jnp.where(mask, get_col(buf.kind, slot), 0)
-                pay = jnp.where(mask[None, :], get_col(buf.p, slot), 0)
-                return buf._replace(
-                    kind=jnp.where(sel, 0, buf.kind),
-                    time=jnp.where(sel, ev.I64_MAX, buf.time),
-                    self_ctr=buf.self_ctr + min_t + kind + pay[0],
-                )
+                buf, p = ev.pop_until(buf, until, extract="gather")
+                return buf._replace(self_ctr=buf.self_ctr + p.time)
 
             timeit("pop_gat", step, seeded_buf(C))
+        elif probe == "pop_f":
+            from shadow1_tpu.core.popk import pop_until_fused
+
+            def step(buf):
+                buf, p = pop_until_fused(buf, until, interpret=interp)
+                return buf._replace(self_ctr=buf.self_ctr + p.time)
+
+            timeit("pop_f", step, seeded_buf(C))
+        elif probe == "push_f":
+            from shadow1_tpu.core.popk import push_local_fused
+
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(buf):
+                buf2, _over = push_local_fused(
+                    buf, m, buf.self_ctr + 1, k, pay, interpret=interp
+                )
+                return buf2._replace(kind=buf.kind)
+
+            timeit("push_f", step, seeded_buf(C // 2))
+        elif probe == "cycle_f":
+            from shadow1_tpu.core.popk import pop_until_fused, push_local_fused
+
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(buf):
+                buf, p = pop_until_fused(buf, until, interpret=interp)
+                buf, _over = push_local_fused(buf, p.mask & m, p.time + 7, k,
+                                              pay, interpret=interp)
+                return buf
+
+            timeit("cycle_f", step, seeded_buf(C // 2))
+        elif probe == "obox_f":
+            from shadow1_tpu.core import outbox as ob
+            from shadow1_tpu.core.popk import outbox_append_fused
+
+            dst = jnp.ones(H, jnp.int32)
+            k = jnp.ones(H, jnp.int32)
+            pay = jnp.zeros((NP, H), jnp.int32)
+            m = jnp.ones(H, bool)
+
+            def step(box):
+                box2, _ok = outbox_append_fused(
+                    box, m, dst, k, box.pkt_ctr + 7, pay, interpret=interp
+                )
+                return box2._replace(cnt=box.cnt)
+
+            timeit("obox_f", step, ob.outbox_init(H, 64))
         elif probe == "wcycle":
             k = jnp.ones(H, jnp.int32)
             pay = jnp.zeros((NP, H), jnp.int32)
@@ -263,7 +317,9 @@ def main() -> int:
                 model_cfg={"mean_delay_ns": float(60 * MS),
                            "init_events": 4},
             )
-            eng = Engine(exp, EngineParams(ev_cap=C))
+            impl = "pallas" if args.pallas else "xla"
+            eng = Engine(exp, EngineParams(ev_cap=C, pop_impl=impl,
+                                           push_impl=impl))
             st0 = eng.run(eng.init_state(), n_windows=10)  # warm state
             jax.block_until_ready(st0)
             m0 = Engine.metrics_dict(st0)
@@ -290,7 +346,8 @@ def main() -> int:
             def step(buf):
                 buf2, _over = ev.deliver_batch(buf, dst, t, tb, k, pay, m)
                 # hold occupancy: keep the timing honest across iters
-                return buf2._replace(kind=buf.kind, time=buf.time)
+                return buf2._replace(kind=buf.kind, time_hi=buf.time_hi,
+                                     time_lo=buf.time_lo, t32=buf.t32)
 
             timeit("deliver", step, seeded_buf(C // 2))
         else:

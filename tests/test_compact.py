@@ -44,8 +44,8 @@ def test_phold_compact_parity(cap):
         np.asarray(Engine(exp, base).model_summary(plain)["hops"]),
     )
     for a, b in zip(
-        [plain.evbuf.time, plain.evbuf.kind, plain.cpu_busy],
-        [comp.evbuf.time, comp.evbuf.kind, comp.cpu_busy],
+        [plain.evbuf.abs_time(), plain.evbuf.kind, plain.cpu_busy],
+        [comp.evbuf.abs_time(), comp.evbuf.kind, comp.cpu_busy],
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 

@@ -9,6 +9,9 @@ from shadow1_tpu.core.events import (
     evbuf_init,
     pop_until,
     push_local,
+    rebase,
+    tb_join,
+    tb_split,
 )
 
 ZP = lambda h: jnp.zeros((NP, h), jnp.int32)
@@ -65,9 +68,79 @@ def test_deliver_batch_ranks_and_overflow():
     assert int(n_over) == 1
     counts = np.asarray((buf.kind != 0).sum(axis=0))
     assert counts.tolist() == [1, 2, 1]
+    # deliver_batch writes absolute times only; the window-start rebase
+    # refreshes the i32 pop keys before the next round loop reads them
+    # (core/engine.py window_step order).
+    buf = rebase(buf, 0)
     # Host 1 keeps its two earliest-listed packets (rank order), pops in time order.
     buf, ev = pop_until(buf, jnp.int64(10**9))
     assert ev.time.tolist()[1] == 10 and ev.time.tolist()[2] == 40
+
+
+def test_far_future_event_beyond_i32_horizon():
+    """An event scheduled past the 2**31-ns rebase horizon saturates the i32
+    pop key (ineligible) until the epoch catches up, then pops at its exact
+    time — the Tor bootstrap / long-RTO shape (core/events.py t32)."""
+    buf = evbuf_init(1, 4)
+    one = jnp.ones(1, bool)
+    k = jnp.full(1, K_PHOLD, jnp.int32)
+    t_far = 5 * 10**9  # +5 s, ~2.3x past the horizon at epoch 0
+    buf, over = push_local(buf, one, jnp.full(1, t_far, jnp.int64), k, ZP(1))
+    assert not bool(over[0])
+    # Windows advance in 1-second steps; the event must stay invisible even
+    # to a generous until bound while clamped.
+    for epoch in range(0, 5 * 10**9, 10**9):
+        buf = rebase(buf, epoch)
+        buf, ev = pop_until(buf, jnp.int64(epoch + 10**9))
+        assert not bool(ev.mask[0]), epoch
+    buf = rebase(buf, 5 * 10**9 - 1)
+    buf, ev = pop_until(buf, jnp.int64(5 * 10**9 + 1))
+    assert bool(ev.mask[0]) and int(ev.time[0]) == t_far
+
+
+def test_tb_split_join_order():
+    """tb_split is an order-preserving bijection into lexicographic
+    (hi, lo) i32 — including low words with the top bit set (the sign-flip
+    encoding) and the packet-tb range."""
+    vals = np.array(
+        [0, 1, 2**31 - 1, 2**31, 2**32 - 1, 2**32, (1 << 62) + 7,
+         (1 << 62) + (5 << 32) + 0xFFFFFFFF, (1 << 62) + (6 << 32)],
+        dtype=np.int64,
+    )
+    hi, lo = tb_split(jnp.asarray(vals))
+    back = np.asarray(tb_join(hi, lo))
+    np.testing.assert_array_equal(back, vals)
+    # Lexicographic (hi, signed lo) order == numeric order.
+    pairs = list(zip(np.asarray(hi).tolist(), np.asarray(lo).tolist()))
+    order = sorted(range(len(vals)), key=lambda i: pairs[i])
+    assert order == sorted(range(len(vals)), key=lambda i: int(vals[i]))
+
+
+def test_pop_fused_pallas_matches_xla():
+    """The Pallas fused pop kernel (core/popk.py, interpret mode on CPU) is
+    bit-identical to the XLA reduction chain — buffer planes and every
+    Popped field, across a drain of a randomly seeded buffer with time and
+    tie-break collisions."""
+    from shadow1_tpu.core.popk import pop_until_fused
+
+    rng = np.random.default_rng(11)
+    h, c = 7, 12
+    buf = evbuf_init(h, c)
+    k = jnp.full(h, K_PHOLD, jnp.int32)
+    for _ in range(c - 2):
+        m = jnp.asarray(rng.random(h) < 0.85)
+        # Narrow time range to force same-time ties (tb must break them).
+        t = jnp.asarray(rng.integers(1, 6, h), jnp.int64)
+        p = jnp.asarray(rng.integers(0, 99, (NP, h)), jnp.int32)
+        buf, _ = push_local(buf, m, t, k, p)
+    a, b = buf, buf
+    for _ in range(c):
+        a, ea = pop_until(a, jnp.int64(10**9))
+        b, eb = pop_until_fused(b, jnp.int64(10**9), interpret=True)
+        for fa, fb in zip(ea, eb):
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    for fa, fb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
 
 
 def test_pop_extract_gather_matches_sum():
