@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from shadow1_tpu.consts import K_NONE
-from shadow1_tpu.core.events import I32_FREE, until32
+from shadow1_tpu.core.events import I32_FREE
 
 # Ctx fields indexed by LOCAL host lane (everything else — vertex tables,
 # host_vertex (global-id-indexed), scalars, static flags — stays as is).
@@ -50,9 +50,10 @@ def active_mask(evbuf, win_end) -> jnp.ndarray:
     """bool [H]: host has ≥1 eligible event this window (= will pop).
 
     Runs after the window-start rebase (core/engine.py window_step), so the
-    i32 t32 plane is current — no i64 pass here."""
-    u32 = until32(evbuf, win_end)
-    return ((evbuf.kind != K_NONE) & (evbuf.t32 < u32)).any(axis=0)
+    maintained per-host eligible counters are current — an [H]-vector read,
+    no [C, H] plane scan (core/events.py n_elig)."""
+    del win_end  # pinned at rebase time (evbuf.u32)
+    return evbuf.n_elig > 0
 
 
 def compact_perm(active: jnp.ndarray, cap: int):
@@ -131,6 +132,9 @@ def compact_window_rounds(st, ctx, handlers, make_handlers, run_rounds,
         evbuf_c = evbuf_c._replace(
             kind=jnp.where(lane_pad[None, :], K_NONE, evbuf_c.kind),
             t32=jnp.where(lane_pad[None, :], I32_FREE, evbuf_c.t32),
+            # A clone lane with a live n_elig copy would spin the round
+            # loop (it can never pop, its count never drains).
+            n_elig=jnp.where(lane_pad, 0, evbuf_c.n_elig),
         )
         st_c = st._replace(evbuf=evbuf_c, outbox=outbox_c, model=model_c,
                            cpu_busy=busy_c)

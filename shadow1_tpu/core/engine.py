@@ -424,7 +424,7 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     # Advance the i32 pop-key epoch to this window's start (core/events.py:
     # the round loop below runs i64-free; pre_window and last window's
     # delivery write absolute times only, repaired here).
-    st = st._replace(evbuf=rebase(st.evbuf, st.win_start))
+    st = st._replace(evbuf=rebase(st.evbuf, st.win_start, win_end))
     ccap = ctx.params.compact_cap
     # push_impl scopes over the round tracing: every handler-layer
     # push_local/push_back below dispatches to the selected implementation

@@ -77,6 +77,13 @@ I32_MAX = jnp.iinfo(jnp.int32).max
 # by the engine), so neither can pop.
 I32_FREE = I32_MAX
 I32_HORIZON = I32_MAX - 1
+# Lower clamp: PAST-DUE events (left eligible by a max_rounds cap-hit
+# window) rebase to NEGATIVE t32 so their (time, tb) order and exact
+# reconstructed times survive into the next window — they sort before
+# every in-window event, as the i64 semantics require. Only a backlog
+# older than ~2.1 s would hit this clamp (and lose exactness); a cap-hit
+# run that deep is already flagged by the round_cap_hits metric.
+I32_PASTDUE = -I32_HORIZON
 _SIGN = jnp.int32(-0x80000000)  # == 1 << 31 as a signed bit pattern
 
 
@@ -99,8 +106,9 @@ def tb_join(hi, lo) -> jnp.ndarray:
 
 
 def _t32_of(time, epoch) -> jnp.ndarray:
-    """Rebased saturating pop key for absolute time(s) ≥ epoch."""
-    return jnp.clip(time - epoch, 0, I32_HORIZON).astype(jnp.int32)
+    """Rebased saturating pop key; exact (and order-exact) for times within
+    (epoch - 2**31 + 2, epoch + 2**31 - 1)."""
+    return jnp.clip(time - epoch, I32_PASTDUE, I32_HORIZON).astype(jnp.int32)
 
 
 class EventBuf(NamedTuple):
@@ -118,6 +126,15 @@ class EventBuf(NamedTuple):
     p: jnp.ndarray         # i32 [NP, C, H] payload columns
     self_ctr: jnp.ndarray  # i64 [H] counter for locally-pushed tb keys
     epoch: jnp.ndarray     # i64 scalar — t32 = clamp(time - epoch)
+    # Running per-host count of events eligible before ``u32`` — maintained
+    # incrementally by push/pop (cheap [H]-vector arithmetic) so the round
+    # loop's continue-condition and the compaction active mask read a
+    # vector instead of re-scanning the [C, H] planes every round. Only
+    # valid between a ``rebase`` (which recomputes it and pins ``u32``)
+    # and the next window-granularity mutation (deliver_batch/pre_window
+    # rewrites leave it stale, exactly like t32).
+    n_elig: jnp.ndarray    # i32 [H]
+    u32: jnp.ndarray       # i32 scalar eligibility bound of n_elig
 
     def abs_time(self) -> jnp.ndarray:
         """i64 [C, H] absolute times (window-granularity readers only)."""
@@ -144,21 +161,29 @@ def evbuf_init(n_hosts: int, cap: int) -> EventBuf:
         p=jnp.zeros((NP, cap, n_hosts), jnp.int32),
         self_ctr=jnp.zeros(n_hosts, jnp.int64),
         epoch=jnp.zeros((), jnp.int64),
+        n_elig=jnp.zeros(n_hosts, jnp.int32),
+        u32=jnp.asarray(I32_HORIZON, jnp.int32),
     )
 
 
-def rebase(buf: EventBuf, epoch) -> EventBuf:
+def rebase(buf: EventBuf, epoch, until=None) -> EventBuf:
     """Advance the t32 plane's epoch (once per window, off the round path).
 
     Recomputes t32 from the authoritative absolute times — this is also
     what makes window-end ``deliver_batch`` and pre-window event rewrites
     free to skip t32 maintenance: any staleness is repaired here before the
-    next round loop reads it."""
+    next round loop reads it. ``until`` (default: the saturation horizon)
+    pins the eligibility bound the ``n_elig`` counters are maintained
+    against — the engine passes win_end."""
     epoch = jnp.asarray(epoch, jnp.int64)
     t32 = jnp.where(
         buf.kind != K_NONE, _t32_of(buf.abs_time(), epoch), I32_FREE
     )
-    return buf._replace(t32=t32, epoch=epoch)
+    u32 = (jnp.asarray(I32_HORIZON, jnp.int32) if until is None
+           else jnp.clip(jnp.asarray(until, jnp.int64) - epoch, 0,
+                         I32_HORIZON).astype(jnp.int32))
+    n_elig = (t32 < u32).sum(axis=0, dtype=jnp.int32)
+    return buf._replace(t32=t32, epoch=epoch, n_elig=n_elig, u32=u32)
 
 
 def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarray]:
@@ -176,16 +201,18 @@ def push_local(buf: EventBuf, mask, time, kind, p) -> tuple[EventBuf, jnp.ndarra
     w = first & ok[None, :]
     time = jnp.asarray(time, jnp.int64)
     thi, tlo = tb_split(time)
+    t32v = _t32_of(time, buf.epoch)
     hi, lo = tb_split(buf.self_ctr)
     buf = buf._replace(
         time_hi=jnp.where(w, thi[None, :], buf.time_hi),
         time_lo=jnp.where(w, tlo[None, :], buf.time_lo),
-        t32=jnp.where(w, _t32_of(time, buf.epoch)[None, :], buf.t32),
+        t32=jnp.where(w, t32v[None, :], buf.t32),
         tb_hi=jnp.where(w, hi[None, :], buf.tb_hi),
         tb_lo=jnp.where(w, lo[None, :], buf.tb_lo),
         kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
         p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
         self_ctr=buf.self_ctr + ok.astype(jnp.int64),
+        n_elig=buf.n_elig + (ok & (t32v < buf.u32)).astype(jnp.int32),
     )
     return buf, mask & ~has_free
 
@@ -206,15 +233,17 @@ def push_back(buf: EventBuf, mask, time, tb, kind, p) -> tuple[EventBuf, jnp.nda
     w = first & ok[None, :]
     time = jnp.asarray(time, jnp.int64)
     thi, tlo = tb_split(time)
+    t32v = _t32_of(time, buf.epoch)
     hi, lo = tb_split(jnp.asarray(tb, jnp.int64))
     buf = buf._replace(
         time_hi=jnp.where(w, thi[None, :], buf.time_hi),
         time_lo=jnp.where(w, tlo[None, :], buf.time_lo),
-        t32=jnp.where(w, _t32_of(time, buf.epoch)[None, :], buf.t32),
+        t32=jnp.where(w, t32v[None, :], buf.t32),
         tb_hi=jnp.where(w, hi[None, :], buf.tb_hi),
         tb_lo=jnp.where(w, lo[None, :], buf.tb_lo),
         kind=jnp.where(w, jnp.asarray(kind, jnp.int32)[None, :], buf.kind),
         p=jnp.where(w[None], jnp.asarray(p, jnp.int32)[:, None, :], buf.p),
+        n_elig=buf.n_elig + (ok & (t32v < buf.u32)).astype(jnp.int32),
     )
     return buf, mask & ~has_free
 
@@ -271,12 +300,19 @@ def pop_until(buf: EventBuf, until, extract: str = "sum") -> tuple[EventBuf, Pop
     buf = buf._replace(
         kind=jnp.where(sel, K_NONE, buf.kind),
         t32=jnp.where(sel, I32_FREE, buf.t32),
+        n_elig=buf.n_elig - mask.astype(jnp.int32),
     )
     return buf, ev
 
 
 def any_eligible(buf: EventBuf, until) -> jnp.ndarray:
-    return ((buf.kind != K_NONE) & (buf.t32 < until32(buf, until))).any()
+    """True if any host still has an eligible event. Reads the maintained
+    [H] counters, NOT the [C, H] planes — exact whenever ``until`` matches
+    the bound pinned by the last ``rebase`` (the engine always passes
+    win_end to both; arbitrary other ``until`` values are not supported
+    here and must scan the planes directly)."""
+    del until  # pinned at rebase time (buf.u32)
+    return (buf.n_elig > 0).any()
 
 
 def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf, jnp.ndarray]:

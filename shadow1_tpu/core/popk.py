@@ -144,7 +144,11 @@ def pop_until_fused(buf: ev.EventBuf, until, *,
         p=po[:, 0, :],
         tb=jnp.where(mask, ev.tb_join(mhi, mlo), 0),
     )
-    return buf._replace(t32=t32o, kind=kindo), popped
+    buf = buf._replace(
+        t32=t32o, kind=kindo,
+        n_elig=buf.n_elig - mask.astype(jnp.int32),
+    )
+    return buf, popped
 
 
 def _push_kernel(maskv_ref, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
@@ -218,14 +222,14 @@ def _push_fused(buf: ev.EventBuf, mask, time, tb, kind, p, *,
         buf.p, interpret=interpret,
     )
     over = (over[0] != 0) & mask
+    ok = mask & ~over
     buf = buf._replace(
         time_hi=thi, time_lo=tlo, t32=t32, tb_hi=bhi, tb_lo=blo,
         kind=kindo, p=po,
+        n_elig=buf.n_elig + (ok & (t32_v < buf.u32)).astype(jnp.int32),
     )
     if advance_ctr:
-        buf = buf._replace(
-            self_ctr=buf.self_ctr + (mask & ~over).astype(jnp.int64)
-        )
+        buf = buf._replace(self_ctr=buf.self_ctr + ok.astype(jnp.int64))
     return buf, over
 
 

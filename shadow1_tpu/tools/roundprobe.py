@@ -125,7 +125,6 @@ def main() -> int:
               flush=True)
 
     until = jnp.int64(1 << 30)                      # everything eligible
-    interp = jax.default_backend() != "tpu"         # pallas interpret mode
     until_i32 = jnp.int32(1 << 30)
 
     for probe in args.probes:
@@ -168,7 +167,7 @@ def main() -> int:
             from shadow1_tpu.core.popk import pop_until_fused
 
             def step(buf):
-                buf, p = pop_until_fused(buf, until, interpret=interp)
+                buf, p = pop_until_fused(buf, until)
                 return buf._replace(self_ctr=buf.self_ctr + p.time)
 
             timeit("pop_f", step, seeded_buf(C))
@@ -181,7 +180,7 @@ def main() -> int:
 
             def step(buf):
                 buf2, _over = push_local_fused(
-                    buf, m, buf.self_ctr + 1, k, pay, interpret=interp
+                    buf, m, buf.self_ctr + 1, k, pay
                 )
                 return buf2._replace(kind=buf.kind)
 
@@ -194,9 +193,9 @@ def main() -> int:
             m = jnp.ones(H, bool)
 
             def step(buf):
-                buf, p = pop_until_fused(buf, until, interpret=interp)
+                buf, p = pop_until_fused(buf, until)
                 buf, _over = push_local_fused(buf, p.mask & m, p.time + 7, k,
-                                              pay, interpret=interp)
+                                              pay)
                 return buf
 
             timeit("cycle_f", step, seeded_buf(C // 2))
@@ -211,7 +210,7 @@ def main() -> int:
 
             def step(box):
                 box2, _ok = outbox_append_fused(
-                    box, m, dst, k, box.pkt_ctr + 7, pay, interpret=interp
+                    box, m, dst, k, box.pkt_ctr + 7, pay
                 )
                 return box2._replace(cnt=box.cnt)
 

@@ -98,6 +98,32 @@ def test_far_future_event_beyond_i32_horizon():
     assert bool(ev.mask[0]) and int(ev.time[0]) == t_far
 
 
+def test_past_due_events_keep_exact_time_and_order():
+    """Events left eligible by a max_rounds cap-hit window rebase to a LATER
+    epoch: their reconstructed pop times must stay exact and their (time,
+    tb) order must survive — t32 goes negative rather than clamping to 0
+    (core/events.py I32_PASTDUE; round-5 review finding)."""
+    from shadow1_tpu.core.popk import pop_until_fused
+
+    buf = evbuf_init(1, 4)
+    one = jnp.ones(1, bool)
+    k = jnp.full(1, K_PHOLD, jnp.int32)
+    # Three events, all before the NEXT window's start (past-due there).
+    for t in (300, 100, 200):
+        buf, _ = push_local(buf, one, jnp.full(1, t, jnp.int64), k, ZP(1))
+    for fused in (False, True):
+        b = rebase(buf, 1000, 2000)  # epoch has moved past all three
+        seen = []
+        for _ in range(3):
+            if fused:
+                b, ev2 = pop_until_fused(b, jnp.int64(2000))
+            else:
+                b, ev2 = pop_until(b, jnp.int64(2000))
+            assert bool(ev2.mask[0])
+            seen.append(int(ev2.time[0]))
+        assert seen == [100, 200, 300], (fused, seen)
+
+
 def test_tb_split_join_order():
     """tb_split is an order-preserving bijection into lexicographic
     (hi, lo) i32 — including low words with the top bit set (the sign-flip
