@@ -37,6 +37,12 @@ def main() -> int:
     ap.add_argument("--windows", type=int, default=None)
     args = ap.parse_args()
 
+    # Oracle-only tool: never touch the accelerator (a wedged tunnel
+    # hangs jax init — platform.py); the CPU platform is forced before any
+    # jax array exists.
+    from shadow1_tpu.platform import force_cpu
+
+    force_cpu(1)
     from shadow1_tpu.config.experiment import load_experiment
     from shadow1_tpu.consts import K_PKT
     from shadow1_tpu.cpu_engine import CpuEngine
