@@ -522,6 +522,30 @@ def _model_module(name: str):
     raise ValueError(f"unknown model {name!r}")
 
 
+def _resolve_kernel_impls(params: EngineParams, n_hosts: int) -> EngineParams:
+    """Downgrade pop_impl/push_impl='pallas' to 'xla' when the gridless fused
+    kernels cannot hold the plane set in VMEM at this (cap, n_hosts) — the
+    kernels would otherwise raise mid-trace (core/popk.py _check_vmem).
+    Logged, not silent: the selection is a measured perf knob."""
+    if "pallas" not in (params.pop_impl, params.push_impl):
+        return params
+    from shadow1_tpu.core import popk
+
+    try:
+        popk.preflight(params.ev_cap, params.outbox_cap, n_hosts,
+                       pop_pallas=params.pop_impl == "pallas",
+                       push_pallas=params.push_impl == "pallas")
+    except ValueError as e:
+        import warnings
+
+        warnings.warn(f"pallas kernels unavailable at this shape ({e}); "
+                      "falling back to pop_impl=push_impl='xla'")
+        import dataclasses
+
+        params = dataclasses.replace(params, pop_impl="xla", push_impl="xla")
+    return params
+
+
 class Engine:
     """Batched engine for one CompiledExperiment.
 
@@ -533,6 +557,7 @@ class Engine:
         exp.validate()
         self.exp = exp
         self.params = params or EngineParams()
+        self.params = _resolve_kernel_impls(self.params, exp.n_hosts)
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
         self.ctx = Ctx(

@@ -16,11 +16,14 @@ lexicographic (t32, tb_hi, tb_lo) masked-min chain, same equality one-hot
 same masked-sum extraction — asserted bit-equal in tests/test_events.py
 and selectable per-run via EngineParams.pop_impl = "pallas".
 
-Grid: 1-D over lane (host) tiles; each program instance sees every slot of
-its host tile ([C, BH] blocks), so the reduction never crosses program
-instances. The lane tile shrinks as ev_cap grows to hold the block set
-(keys + NP payload planes) under the ~16 MB VMEM budget. The updated
-t32/kind planes alias their inputs (in-place update, no spare HBM copy).
+The kernels run GRIDLESS: one program instance, whole-array blocks. The
+axon tunnel's AOT Mosaic pipeline fails to legalize ANY grid-ful kernel
+(even a trivial ``grid=(1,)`` copy kernel dies with ``failed to legalize
+operation 'func.return'`` — measured round 5, docs/PERF.md), so the full
+plane set (keys + NP payload planes) must fit the ~12 MB VMEM budget;
+``preflight`` checks this and the engine falls back to the XLA impls when
+it cannot hold. The updated t32/kind planes alias their inputs (in-place
+update, no spare HBM copy).
 
 Reference anchor: this kernel is the batched analogue of the per-host
 binary-heap pop in the reference's worker loop
@@ -41,55 +44,93 @@ from shadow1_tpu.consts import K_NONE, NP
 from shadow1_tpu.core import events as ev
 
 
-def _lane_tile(cap: int, planes: int) -> int:
-    """Lane-tile width holding ``planes`` i32 [cap, BH] blocks in ~8 MB of
-    VMEM. The minimum useful tile is one lane group (128); a cap so large
-    that even 128 lanes blow the budget is rejected loudly instead of
-    silently compiling an over-VMEM kernel."""
-    budget = 8 * 2**20 // (4 * planes * cap)
-    if budget < 128:
+# Plane counts per kernel call (inputs + aliased outputs resident in VMEM);
+# shared by the per-call checks and the engine-facing preflight so the two
+# cannot drift.
+POP_PLANES = 6 + NP
+PUSH_PLANES = 7 + NP
+OBOX_PLANES = 5 + NP
+
+
+def _check_vmem(cap: int, h: int, planes: int, knob: str = "ev_cap") -> None:
+    """The kernels run GRIDLESS — one program instance, whole-array blocks —
+    because the axon tunnel's AOT Mosaic pipeline fails to legalize any
+    grid-ful kernel (``failed to legalize operation 'func.return'`` for even
+    a trivial ``grid=(1,)`` copy kernel; measured round 5, docs/PERF.md).
+    Whole-array blocks mean the full plane set must fit VMEM; reject loudly
+    instead of silently compiling an over-VMEM kernel."""
+    need = 4 * planes * cap * h
+    if need > 12 * 2**20:
         raise ValueError(
-            f"ev_cap={cap} needs {4 * planes * cap * 128 / 2**20:.1f} MB "
-            "per 128-lane tile — beyond the fused-kernel VMEM budget; use "
-            "pop_impl/push_impl='xla' for caps this deep"
+            f"{knob}={cap} x {h} hosts needs {need / 2**20:.1f} MB of VMEM "
+            "for the gridless fused kernels; use pop_impl/push_impl='xla' "
+            "for shapes this large"
         )
-    return min(1 << (budget.bit_length() - 1), 2048)
+
+
+def preflight(ev_cap: int, outbox_cap: int, h: int,
+              pop_pallas: bool, push_pallas: bool) -> None:
+    """Raise ValueError if any SELECTED fused kernel cannot hold its plane
+    set in VMEM at this shape. No-op off-TPU: every other backend runs the
+    kernels in interpret mode (_resolve_interpret), which has no VMEM."""
+    if jax.default_backend() != "tpu":
+        return
+    if pop_pallas:
+        _check_vmem(ev_cap, h, planes=POP_PLANES)
+    if push_pallas:
+        _check_vmem(ev_cap, h, planes=PUSH_PLANES)
+        _check_vmem(outbox_cap, h, planes=OBOX_PLANES, knob="outbox_cap")
+
+
+# Mosaic cannot lower i64, and under x64 a Python int scalar crossing a jit
+# boundary (jnp.where's) commits as i64 — as does jnp.sum's default integer
+# accumulator. Every scalar constant inside the kernels is therefore an
+# explicit jnp.int32 (built INSIDE the kernel body: Pallas rejects captured
+# array constants) and every sum pins dtype=int32; a stray i64 here makes
+# Mosaic's i64->i32 convert rule recurse to a RecursionError at lowering.
+
+
+def _consts32():
+    return (jnp.int32(ev.I32_FREE), jnp.int32(ev.I32_MAX),
+            jnp.int32(K_NONE), jnp.int32(0))
 
 
 def _pop_kernel(until_ref, t32_ref, hi_ref, lo_ref, kind_ref, p_ref,
                 t32o_ref, kindo_ref, mt_ref, mhi_ref, mlo_ref, ko_ref,
                 po_ref):
+    _I32_FREE, _I32_MAX, _K_NONE32, _ZERO32 = _consts32()
     u = until_ref[0]
     t = t32_ref[:, :]                                   # [C, BH] i32
     k = kind_ref[:, :]
-    elig = (k != K_NONE) & (t < u)
-    tm = jnp.where(elig, t, ev.I32_FREE)
+    elig = (k != _K_NONE32) & (t < u)
+    tm = jnp.where(elig, t, _I32_FREE)
     mint = tm.min(axis=0, keepdims=True)                # [1, BH]
     tie = elig & (tm == mint)
-    him = jnp.where(tie, hi_ref[:, :], ev.I32_MAX)
+    him = jnp.where(tie, hi_ref[:, :], _I32_MAX)
     minhi = him.min(axis=0, keepdims=True)
     tie2 = tie & (him == minhi)
-    lom = jnp.where(tie2, lo_ref[:, :], ev.I32_MAX)
+    lom = jnp.where(tie2, lo_ref[:, :], _I32_MAX)
     minlo = lom.min(axis=0, keepdims=True)
     sel = tie2 & (lom == minlo)                         # one-hot per host
-    t32o_ref[:, :] = jnp.where(sel, ev.I32_FREE, t)
-    kindo_ref[:, :] = jnp.where(sel, K_NONE, k)
+    t32o_ref[:, :] = jnp.where(sel, _I32_FREE, t)
+    kindo_ref[:, :] = jnp.where(sel, _K_NONE32, k)
     mt_ref[:, :] = mint
     mhi_ref[:, :] = minhi
     mlo_ref[:, :] = minlo
-    ko_ref[:, :] = jnp.where(sel, k, 0).sum(axis=0, keepdims=True)
-    po_ref[:, :, :] = jnp.where(sel[None], p_ref[:, :, :], 0).sum(
-        axis=1, keepdims=True
+    ko_ref[:, :] = jnp.where(sel, k, _ZERO32).sum(axis=0, keepdims=True,
+                                                  dtype=jnp.int32)
+    po_ref[:, :, :] = jnp.where(sel[None], p_ref[:, :, :], _ZERO32).sum(
+        axis=1, keepdims=True, dtype=jnp.int32
     )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _pop_call(t32, tb_hi, tb_lo, kind, p, u32, *, interpret=False):
     cap, h = kind.shape
-    bh = _lane_tile(cap, planes=6 + NP)
-    grid = (pl.cdiv(h, bh),)
-    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
-    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
+    if not interpret:
+        _check_vmem(cap, h, planes=POP_PLANES)
+    blk2 = pl.BlockSpec((cap, h), lambda: (0, 0))
+    vec = pl.BlockSpec((1, h), lambda: (0, 0))
     out_shapes = (
         jax.ShapeDtypeStruct((cap, h), jnp.int32),   # t32'
         jax.ShapeDtypeStruct((cap, h), jnp.int32),   # kind'
@@ -101,15 +142,14 @@ def _pop_call(t32, tb_hi, tb_lo, kind, p, u32, *, interpret=False):
     )
     return pl.pallas_call(
         _pop_kernel,
-        grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # until32 (1,)
             blk2, blk2, blk2, blk2,
-            pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i)),
+            pl.BlockSpec((NP, cap, h), lambda: (0, 0, 0)),
         ],
         out_specs=(
             blk2, blk2, vec, vec, vec, vec,
-            pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i)),
+            pl.BlockSpec((NP, 1, h), lambda: (0, 0, 0)),
         ),
         out_shape=out_shapes,
         input_output_aliases={1: 0, 4: 1},           # t32, kind in-place
@@ -154,13 +194,14 @@ def pop_until_fused(buf: ev.EventBuf, until, *,
 def _push_kernel(maskv_ref, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
                  thi_ref, tlo_ref, t32_ref, bhi_ref, blo_ref, kind_ref, p_ref,
                  thi_o, tlo_o, t32_o, bhi_o, blo_o, kind_o, p_o, over_o):
+    _I32_FREE, _I32_MAX, _K_NONE32, _ZERO32 = _consts32()
     k = kind_ref[:, :]                                  # [C, BH]
-    free = k == K_NONE
+    free = k == _K_NONE32
     idx = jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
-    cap = k.shape[0]
+    cap = jnp.int32(k.shape[0])
     fidx = jnp.where(free, idx, cap).min(axis=0, keepdims=True)  # [1, BH]
     has = fidx < cap
-    mv = maskv_ref[:, :] != 0
+    mv = maskv_ref[:, :] != _ZERO32
     ok = mv & has
     w = free & (idx == fidx) & ok
     thi_o[:, :] = jnp.where(w, thi_v[:, :], thi_ref[:, :])
@@ -177,12 +218,12 @@ def _push_kernel(maskv_ref, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
 def _push_call(maskv, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
                thi, tlo, t32, bhi, blo, kind, p, *, interpret=False):
     cap, h = kind.shape
-    bh = _lane_tile(cap, planes=7 + NP)
-    grid = (pl.cdiv(h, bh),)
-    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
-    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
-    pvec = pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i))
-    pblk = pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i))
+    if not interpret:
+        _check_vmem(cap, h, planes=PUSH_PLANES)
+    blk2 = pl.BlockSpec((cap, h), lambda: (0, 0))
+    vec = pl.BlockSpec((1, h), lambda: (0, 0))
+    pvec = pl.BlockSpec((NP, 1, h), lambda: (0, 0, 0))
+    pblk = pl.BlockSpec((NP, cap, h), lambda: (0, 0, 0))
     plane = jax.ShapeDtypeStruct((cap, h), jnp.int32)
     out_shapes = (
         plane, plane, plane, plane, plane, plane,
@@ -191,7 +232,6 @@ def _push_call(maskv, thi_v, tlo_v, t32_v, bhi_v, blo_v, kind_v, p_v,
     )
     return pl.pallas_call(
         _push_kernel,
-        grid=grid,
         in_specs=[vec, vec, vec, vec, vec, vec, vec, pvec,
                   blk2, blk2, blk2, blk2, blk2, blk2, pblk],
         out_specs=(blk2, blk2, blk2, blk2, blk2, blk2, pblk, vec),
@@ -250,9 +290,10 @@ def push_back_fused(buf: ev.EventBuf, mask, time, tb, kind, p, *,
 def _obox_kernel(cnt_ref, okv_ref, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
                  dst_ref, kind_ref, dhi_ref, dlo_ref, ctr_ref, p_ref,
                  dst_o, kind_o, dhi_o, dlo_o, ctr_o, p_o):
+    _ZERO32 = _consts32()[3]
     cap = dst_ref.shape[0]
     idx = jax.lax.broadcasted_iota(jnp.int32, (cap,) + cnt_ref.shape[1:], 0)
-    w = (idx == cnt_ref[:, :]) & (okv_ref[:, :] != 0)
+    w = (idx == cnt_ref[:, :]) & (okv_ref[:, :] != _ZERO32)
     dst_o[:, :] = jnp.where(w, dst_v[:, :], dst_ref[:, :])
     kind_o[:, :] = jnp.where(w, kind_v[:, :], kind_ref[:, :])
     dhi_o[:, :] = jnp.where(w, dhi_v[:, :], dhi_ref[:, :])
@@ -265,16 +306,15 @@ def _obox_kernel(cnt_ref, okv_ref, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
 def _obox_call(cnt, okv, dst_v, kind_v, dhi_v, dlo_v, ctr_v, p_v,
                dst, kind, dhi, dlo, ctr, p, *, interpret=False):
     cap, h = dst.shape
-    bh = _lane_tile(cap, planes=5 + NP)
-    grid = (pl.cdiv(h, bh),)
-    blk2 = pl.BlockSpec((cap, bh), lambda i: (0, i))
-    vec = pl.BlockSpec((1, bh), lambda i: (0, i))
-    pvec = pl.BlockSpec((NP, 1, bh), lambda i: (0, 0, i))
-    pblk = pl.BlockSpec((NP, cap, bh), lambda i: (0, 0, i))
+    if not interpret:
+        _check_vmem(cap, h, planes=OBOX_PLANES, knob="outbox_cap")
+    blk2 = pl.BlockSpec((cap, h), lambda: (0, 0))
+    vec = pl.BlockSpec((1, h), lambda: (0, 0))
+    pvec = pl.BlockSpec((NP, 1, h), lambda: (0, 0, 0))
+    pblk = pl.BlockSpec((NP, cap, h), lambda: (0, 0, 0))
     plane = jax.ShapeDtypeStruct((cap, h), jnp.int32)
     return pl.pallas_call(
         _obox_kernel,
-        grid=grid,
         in_specs=[vec, vec, vec, vec, vec, vec, vec, pvec,
                   blk2, blk2, blk2, blk2, blk2, pblk],
         out_specs=(blk2, blk2, blk2, blk2, blk2, pblk),
