@@ -115,3 +115,27 @@ def first_true_idx(m) -> tuple[jnp.ndarray, jnp.ndarray]:
     iota = jnp.arange(cap, dtype=jnp.int32)[:, None]
     first = jnp.min(jnp.where(m, iota, cap), axis=0)
     return m.any(axis=0), jnp.where(first < cap, first, 0).astype(jnp.int32)
+
+
+def payload(n_hosts: int, *rows) -> jnp.ndarray:
+    """Build an [NP, H] i32 payload from per-plane [H] rows (None = zeros).
+
+    ``p.at[i].set(row)`` chains trace to NP scatter primitives per packet
+    construction — ~850 scatter eqns in the rung-3 program before XLA
+    simplification. Stacking builds the same tensor as one concatenate."""
+    from shadow1_tpu.consts import NP
+
+    if len(rows) > NP:
+        raise ValueError(f"payload(): {len(rows)} rows > NP={NP} planes")
+    zeros = None
+    out = []
+    for i in range(NP):
+        r = rows[i] if i < len(rows) else None
+        if r is None:
+            if zeros is None:
+                zeros = jnp.zeros(n_hosts, jnp.int32)
+            r = zeros
+        else:
+            r = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (n_hosts,))
+        out.append(r)
+    return jnp.stack(out)

@@ -171,13 +171,10 @@ def push_local_event(st: SimState, ctx: Ctx, mask, time, kind,
 
     The engine-state-level convenience over events.push_local used by all
     handler layers (transport timers, app wakeups)."""
+    from shadow1_tpu.core.dense import payload
     from shadow1_tpu.core.events import push_local
-    from shadow1_tpu.consts import NP
 
-    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
-    for i, pi in enumerate((p0, p1, p2, p3)):
-        if pi is not None:
-            p = p.at[i].set(jnp.asarray(pi, jnp.int32))
+    p = payload(ctx.n_hosts, p0, p1, p2, p3)
     k = jnp.full(ctx.n_hosts, kind, jnp.int32)
     evbuf, over = push_local(st.evbuf, mask, time, k, p)
     m = st.metrics

@@ -27,10 +27,10 @@ from shadow1_tpu.consts import (
     K_TCP_TIMER,
     K_TX_RESUME,
     N_DGRAM,
-    NP,
     SEC,
     WIRE_OVERHEAD,
 )
+from shadow1_tpu.core.dense import payload
 from shadow1_tpu.core.events import I64_MAX, push_local, tb_split
 from shadow1_tpu.core.outbox import outbox_append
 from shadow1_tpu.net.nic import NicState, ctx_aqm, nic_init, rx_stamp, tx_stamp
@@ -82,12 +82,11 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     The reference's UDP socket (src/main/host/descriptor/udp.c): no
     handshake, no reliability; loss/latency/bandwidth still apply.
     """
-    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
-    p = p.at[0].set(ctx.hosts)
-    p = p.at[1].set(T.pack_meta(0, dst_sock, F_DGRAM))
-    p = p.at[4].set(jnp.asarray(length, jnp.int32))
-    p = p.at[7].set(jnp.asarray(meta, jnp.int32))
-    p = p.at[8].set(jnp.asarray(meta2, jnp.int32))
+    p = payload(
+        ctx.n_hosts, ctx.hosts, T.pack_meta(0, dst_sock, F_DGRAM), None, None,
+        jnp.asarray(length, jnp.int32), None, None,
+        jnp.asarray(meta, jnp.int32), jnp.asarray(meta2, jnp.int32),
+    )
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,

@@ -68,6 +68,7 @@ from shadow1_tpu.consts import (  # noqa: F811 — shared tuning/state sets
 )
 from shadow1_tpu.core.dense import (
     extract_col,
+    payload,
     first_true_idx,
     get_col,
     last_true,
@@ -186,15 +187,11 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
 
     Caller must have established outbox space. Returns engine state.
     """
-    p = jnp.zeros((NP, ctx.n_hosts), jnp.int32)
-    p = p.at[0].set(ctx.hosts)
-    p = p.at[1].set(pack_meta(r.sock, r.g("peer_sock"), flags))
-    p = p.at[2].set(seq)
-    p = p.at[3].set(r.g("rcv_nxt"))
-    p = p.at[4].set(jnp.asarray(length, jnp.int32))
-    p = p.at[5].set(ctx.params.rcvbuf)
-    p = p.at[6].set(mend)
-    p = p.at[7].set(mmeta)
+    p = payload(
+        ctx.n_hosts, ctx.hosts, pack_meta(r.sock, r.g("peer_sock"), flags),
+        seq, r.g("rcv_nxt"), jnp.asarray(length, jnp.int32),
+        ctx.params.rcvbuf, mend, mmeta,
+    )
     wire = jnp.asarray(length, jnp.int64) + WIRE_OVERHEAD
     nic, depart, sent, red = tx_stamp(
         st.model.nic, mask, wire, now, ctx.bw_up,
@@ -383,16 +380,10 @@ def tcp_flush(st, ctx, mask, sock, now):
     pL = []
     p1 = pack_meta(sock, peer_sock, 0)
     for (snt, dep, seq, length, flags, mend, mmeta) in lanes:
-        p = jnp.zeros((NP, H), jnp.int32)
-        p = p.at[0].set(ctx.hosts)
-        p = p.at[1].set(p1 | (flags << 16))
-        p = p.at[2].set(seq)
-        p = p.at[3].set(rcv_nxt)
-        p = p.at[4].set(length)
-        p = p.at[5].set(pr.rcvbuf)
-        p = p.at[6].set(mend)
-        p = p.at[7].set(mmeta)
-        pL.append(p)
+        pL.append(payload(
+            H, ctx.hosts, p1 | (flags << 16), seq, rcv_nxt, length,
+            pr.rcvbuf, mend, mmeta,
+        ))
     ob = ob._replace(
         dst=merge(ob.dst, dstL, jnp.int32),
         kind=jnp.where(written, K_PKT, ob.kind),
