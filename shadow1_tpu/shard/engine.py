@@ -255,11 +255,17 @@ class ShardedEngine:
                 )
                 # Occupancy: the DEMANDED fill of this shard's busiest
                 # outbound bucket this window (can exceed x2x_cap — that is
-                # exactly when overflow happens), pmax'd so every shard
-                # carries the same global high-water mark.
-                fill_hw = jax.lax.pmax(
-                    (seg[1:] - seg[:-1]).max().astype(jnp.int64), axis
+                # exactly when overflow happens), reduced so every shard
+                # carries the same global high-water mark. NOT lax.pmax: the
+                # axon tunnel's AOT compiler lowers only Sum all-reduces
+                # (measured round 5), so the max rides a psum'd one-hot
+                # [n_dev] vector — bit-identical result, sum-only collective.
+                local_fill = (seg[1:] - seg[:-1]).max().astype(jnp.int64)
+                slot = jnp.arange(n_dev) == jax.lax.axis_index(axis)
+                fill_vec = jax.lax.psum(
+                    jnp.where(slot, local_fill, 0), axis
                 )
+                fill_hw = fill_vec.max()
                 stacked = jnp.concatenate(
                     [
                         jnp.stack(
