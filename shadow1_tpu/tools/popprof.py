@@ -23,7 +23,10 @@ import time
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=50)
+    # 5000 for the same reason as roundprobe: one XLA execution per timing
+    # pays ~70 ms of tunnel RTT, which swamps any 50-iter loop
+    # (docs/PERF.md round-5 correction).
+    ap.add_argument("--iters", type=int, default=5000)
     ap.add_argument("--hosts", type=int, default=1000)
     ap.add_argument("--cap", type=int, default=256)
     ap.add_argument("--hlo", action="store_true",

@@ -189,3 +189,51 @@ def test_pop_extract_gather_matches_sum():
             np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
     for fa, fb in zip(a, b):
         np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_payload_matches_at_chain():
+    """dense.payload (stacked rows) is bit-identical to the .at[i].set chain
+    it replaced in the packet builders, including None planes, scalar
+    broadcast, and the over-NP guard."""
+    import pytest
+
+    from shadow1_tpu.core.dense import payload
+
+    rng = np.random.default_rng(7)
+    h = 6
+    rows = [jnp.asarray(rng.integers(0, 99, h), jnp.int32), None,
+            jnp.int32(41), None, jnp.asarray(rng.integers(0, 9, h), jnp.int32)]
+    p = payload(h, *rows)
+    ref = jnp.zeros((NP, h), jnp.int32)
+    for i, r in enumerate(rows):
+        if r is not None:
+            ref = ref.at[i].set(r)
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(ref))
+    assert p.dtype == jnp.int32 and p.shape == (NP, h)
+    with pytest.raises(ValueError, match="rows > NP"):
+        payload(h, *([jnp.int32(0)] * (NP + 1)))
+
+
+def test_pallas_preflight_fallback_shapes():
+    """popk.preflight accepts in-VMEM shapes and rejects over-VMEM ones on
+    TPU; off-TPU (this suite) it must be a no-op so interpret-mode tests
+    keep exercising the kernels at any shape."""
+    import jax
+
+    from shadow1_tpu.core import popk
+
+    # Off-TPU the preflight never raises (interpret mode has no VMEM). On a
+    # TPU-attached run of this suite the same call MUST raise.
+    if jax.default_backend() == "tpu":
+        with np.testing.assert_raises(ValueError):
+            popk.preflight(4096, 4096, 100_000,
+                           pop_pallas=True, push_pallas=True)
+    else:
+        popk.preflight(4096, 4096, 100_000, pop_pallas=True, push_pallas=True)
+    # The underlying check itself rejects over-VMEM and accepts small.
+    popk._check_vmem(64, 1000, planes=popk.POP_PLANES)
+    import pytest
+
+    with pytest.raises(ValueError, match="outbox_cap=4096"):
+        popk._check_vmem(4096, 50_000, planes=popk.OBOX_PLANES,
+                         knob="outbox_cap")
