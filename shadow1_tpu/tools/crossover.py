@@ -38,29 +38,18 @@ CHUNK = 10
 
 def dense_doc(n_hosts: int) -> dict:
     """configs/dense_tgen50k.yaml scaled to ``n_hosts`` (same per-host
-    parameters; only the count changes)."""
-    return {
-        "general": {"seed": 71, "stop_time": "20 s"},
-        "engine": {
-            "scheduler": "tpu", "ev_cap": 160, "outbox_cap": 32,
-            "sockets_per_host": 8, "msgq_cap": 4, "max_rounds": 512,
-            "rcvbuf": 16384,
-        },
-        "network": {"single_vertex": {"latency": "10 ms"}},
-        "hosts": [{
-            "name": "node", "count": n_hosts,
-            "bandwidth_up": "20 Mbit", "bandwidth_down": "20 Mbit",
-        }],
-        "app": {
-            "model": "tgen",
-            "params": {"fixed_size": True},
-            "defaults": {"start_time": "10 ms"},
-            "groups": {"node": {
-                "active": 1, "streams": 1000000,
-                "mean_bytes": 30000000, "mean_think_ns": "50 ms",
-            }},
-        },
-    }
+    parameters; only the count changes). Loaded from the yaml so the
+    exhibit config has ONE source of truth."""
+    import os
+
+    import yaml
+
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "configs",
+                        "dense_tgen50k.yaml")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    doc["hosts"][0]["count"] = n_hosts
+    return doc
 
 
 def child_main(n_hosts: int, windows: int) -> int:
