@@ -57,22 +57,45 @@ def probe_default_backend(deadline_s: float | None = None) -> dict:
     if deadline_s is None:
         deadline_s = float(os.environ.get("SHADOW1_TPU_PROBE_DEADLINE", "45"))
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC],
-            capture_output=True, text=True, timeout=deadline_s,
-        )
-        if out.returncode == 0:
-            _probe_cache = json.loads(out.stdout.strip().splitlines()[-1])
+        # NEVER kill the probe child at the deadline: SIGKILLing a process
+        # inside tunnel device-init is what wedges the tunnel for every
+        # subsequent client (docs/PERF.md round-5). On timeout the child is
+        # left to finish detached (start_new_session) and the caller falls
+        # back to CPU; the orphan exits on its own once init resolves.
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            out_p = os.path.join(td, "out")
+            err_p = os.path.join(td, "err")
+            with open(out_p, "w") as fo, open(err_p, "w") as fe:
+                proc = subprocess.Popen(
+                    [sys.executable, "-c", _PROBE_SRC],
+                    stdout=fo, stderr=fe, text=True,
+                    start_new_session=True,
+                )
+            try:
+                proc.wait(timeout=deadline_s)
+            except subprocess.TimeoutExpired:
+                # Reap the orphan eventually without blocking or killing:
+                # a daemon thread waits it out, avoiding a zombie + the
+                # Popen.__del__ ResourceWarning.
+                import threading
+
+                threading.Thread(target=proc.wait, daemon=True).start()
+                _probe_cache = {
+                    "backend": "", "n_devices": 0,
+                    "error": f"backend init exceeded {deadline_s:.0f}s "
+                             "deadline (probe child left to finish detached)",
+                }
+                return _probe_cache
+            stdout, stderr = open(out_p).read(), open(err_p).read()
+        if proc.returncode == 0:
+            _probe_cache = json.loads(stdout.strip().splitlines()[-1])
         else:
             _probe_cache = {
                 "backend": "", "n_devices": 0,
-                "error": f"rc={out.returncode}: {out.stderr.strip()[-500:]}",
+                "error": f"rc={proc.returncode}: {stderr.strip()[-500:]}",
             }
-    except subprocess.TimeoutExpired:
-        _probe_cache = {
-            "backend": "", "n_devices": 0,
-            "error": f"backend init exceeded {deadline_s:.0f}s deadline",
-        }
     except Exception as e:  # noqa: BLE001 — any probe failure means fallback
         _probe_cache = {"backend": "", "n_devices": 0, "error": repr(e)}
     return _probe_cache

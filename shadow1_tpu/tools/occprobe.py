@@ -40,6 +40,8 @@ def main() -> int:
     args = ap.parse_args()
     if args.step < 1:
         ap.error("--step must be >= 1")
+    if args.windows < 0:
+        ap.error("--windows must be >= 0")
 
     import shadow1_tpu  # noqa: F401
     from shadow1_tpu.platform import force_cpu
@@ -56,7 +58,9 @@ def main() -> int:
         eng = Engine(exp, params)
         nw = args.windows or eng.n_windows
         st = eng.init_state()
-        peak = 0
+        # Count the seeded initial state too — a model that seeds a burst
+        # draining inside the first chunk would otherwise be missed.
+        peak = int((np.asarray(st.evbuf.kind) != 0).sum(axis=0).max())
         done = 0
         while done < nw:
             step = min(args.step, nw - done)
