@@ -13,7 +13,7 @@ cd "$(dirname "$0")"
 
 tier="${1:-fast}"
 case "$tier" in
-  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py -q ;;
+  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py -q ;;
   fast)  exec python -m pytest tests/ -q -m "not slow" ;;
   all)   exec python -m pytest tests/ -q ;;
   *) echo "usage: $0 [smoke|fast|all]" >&2; exit 2 ;;

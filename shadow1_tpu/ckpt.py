@@ -22,7 +22,11 @@ import jax
 #   3: round 5 adds Metrics.x2x_max_fill (exchange occupancy high-water)
 #   4: round 5 i32 round path — EventBuf gains t32/epoch, tb splits into
 #      (tb_hi, tb_lo) i32 planes (core/events.py)
-CKPT_FORMAT = 4
+#   5: round 6 telemetry — SimState gains the optional ``telem`` ring leaf
+#      (present only when EngineParams.metrics_ring > 0; a ring-less state
+#      keeps the v4 leaf layout, but the format is bumped so a ring/ring-less
+#      mismatch fails as a version error, not a confusing leaf-count one)
+CKPT_FORMAT = 5
 
 
 def _flatten(st):
@@ -76,19 +80,30 @@ def load_state(template, path: str):
 
 
 def run_chunked(engine, st=None, n_windows: int | None = None,
-                chunk: int = 0, on_chunk=None):
+                chunk: int = 0, on_chunk=None, profiler=None):
     """Run in fixed-size window chunks, invoking ``on_chunk(st, done)`` after
     each (for checkpoints/heartbeats). One compiled program is reused for
-    every full chunk. Returns the final state."""
+    every full chunk. Returns the final state.
+
+    ``profiler`` (telemetry.PhaseProfiler) records one ``run-chunk`` span
+    per chunk — the dominant phase every trace wants resolved."""
+    from shadow1_tpu.telemetry import PH_INIT, PH_RUN_CHUNK, maybe_span
+
     if st is None:
-        st = engine.init_state()
+        with maybe_span(profiler, PH_INIT):
+            st = engine.init_state()
     total = n_windows if n_windows is not None else engine.n_windows
     if chunk <= 0:
         chunk = total
     done = 0
     while done < total:
         step = min(chunk, total - done)
-        st = engine.run(st, n_windows=step)
+        with maybe_span(profiler, PH_RUN_CHUNK, windows=step, done=done):
+            st = engine.run(st, n_windows=step)
+            if profiler is not None:
+                # Only when tracing: make the span cover execution, not just
+                # async dispatch. Chunk boundary — never inside a window.
+                jax.block_until_ready(st)
         done += step
         if on_chunk is not None:
             on_chunk(st, done)

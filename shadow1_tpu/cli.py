@@ -125,7 +125,17 @@ def main(argv=None) -> int:
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run into DIR "
                          "(open with TensorBoard; reference: heartbeat/"
-                         "tracker profiling hooks, SURVEY §5)")
+                         "tracker profiling hooks, SURVEY §5); also writes "
+                         "DIR/phases.trace.json (the --trace phase spans)")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a Chrome trace-event JSON of the host-side "
+                         "phases (compile/init/run-chunk/drain/checkpoint) "
+                         "to PATH — load in Perfetto or chrome://tracing")
+    ap.add_argument("--metrics-ring", type=int, default=None, metavar="W",
+                    help="keep a W-window on-device telemetry ring and emit "
+                         "one per-window JSONL record to stderr per window "
+                         "(drained at chunk boundaries; overrides "
+                         "engine.metrics_ring from the config)")
     ap.add_argument("--log-level", default="message",
                     choices=["error", "warning", "message", "info", "debug"],
                     help="stderr log verbosity (reference --log-level analogue)")
@@ -135,12 +145,18 @@ def main(argv=None) -> int:
     from shadow1_tpu.config.experiment import load_experiment
 
     exp, params, scheduler = load_experiment(args.config)
+    if args.metrics_ring is not None:
+        import dataclasses
+
+        params = dataclasses.replace(params, metrics_ring=args.metrics_ring)
     engine_kind = args.engine or scheduler
     if engine_kind == "cpu" and (args.save_state or args.resume
                                  or args.heartbeat or args.tracker
-                                 or args.profile or args.ckpt):
+                                 or args.profile or args.ckpt
+                                 or args.trace or args.metrics_ring):
         ap.error("--save-state/--resume/--heartbeat/--tracker/--profile/"
-                 "--ckpt require a batched engine (tpu or sharded)")
+                 "--ckpt/--trace/--metrics-ring require a batched engine "
+                 "(tpu or sharded)")
     if args.ckpt and args.resume and args.windows is not None:
         # Under supervision --windows is the TOTAL for the whole run; under
         # --resume it means N MORE windows. Combining all three makes a
@@ -210,21 +226,43 @@ def main(argv=None) -> int:
 
         prof = (jax.profiler.trace(args.profile) if args.profile
                 else contextlib.nullcontext())
+        phases = None
+        if args.trace or args.profile:
+            from shadow1_tpu.telemetry import PhaseProfiler
+
+            phases = PhaseProfiler()
+        ring_w = params.metrics_ring
         with prof:
-            if args.heartbeat or args.ckpt:
+            # phases covers --profile too: its phases.trace.json must carry
+            # real spans, so any profiled run routes through the
+            # instrumented chunk runner.
+            if args.heartbeat or args.ckpt or ring_w or phases is not None:
                 from shadow1_tpu.obs import run_with_heartbeat
 
                 st, _hb = run_with_heartbeat(
                     eng, st, n_windows=args.windows,
-                    every_windows=args.heartbeat,
-                    # --ckpt without --heartbeat chunks the run for
-                    # checkpointing but emits no heartbeat lines.
-                    stream=None if args.heartbeat else False,
+                    # Ring-only runs chunk at the ring depth so the drain
+                    # keeps up with the overwrites: gap-free per-window
+                    # records without --heartbeat.
+                    every_windows=args.heartbeat or (ring_w or None),
+                    # --ckpt/--trace without --heartbeat chunk the run but
+                    # emit no heartbeat lines; ring records always flow
+                    # when the ring is on.
+                    stream=None if (args.heartbeat or ring_w) else False,
                     ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
+                    profiler=phases,
+                    emit_heartbeat=bool(args.heartbeat),
+                    emit_ring=bool(ring_w),
                 )
             else:
                 st = eng.run(st, n_windows=args.windows)
             jax.block_until_ready(st)
+        if phases is not None:
+            if args.trace:
+                phases.write(args.trace)
+            if args.profile:
+                os.makedirs(args.profile, exist_ok=True)
+                phases.write(os.path.join(args.profile, "phases.trace.json"))
         if args.save_state:
             from shadow1_tpu.ckpt import save_state
 

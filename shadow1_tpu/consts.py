@@ -134,6 +134,13 @@ class EngineParams:
     # this is purely a perf knob. Size from tools/activeprobe.py (rung3
     # p99 = 284 of 1000; rung4 max = 1082 of 10000).
     compact_cap: int = 0
+    # On-device telemetry ring: per-window counter-delta rows kept on
+    # device (telemetry/ring.py) and drained at chunk boundaries. Value =
+    # ring depth in windows (the horizon of per-window records a chunk can
+    # recover); 0 = off — the SimState pytree then carries no ring leaf, so
+    # the default is layout-identical to a ring-less build. Size it ≥ the
+    # heartbeat chunk to get a gap-free time series (CLI --metrics-ring).
+    metrics_ring: int = 0
     # Pop-min result extraction: "sum" (masked-sum over the one-hot — the
     # round-4 default) or "gather" (index via min-over-iota, then
     # take_along_axis — the round-3 style on the round-4 layout). Bit-exact
@@ -164,6 +171,7 @@ class EngineParams:
     def __post_init__(self):
         assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
         assert self.pop_extract in ("sum", "gather"), self.pop_extract
+        assert self.metrics_ring >= 0, self.metrics_ring
         assert self.pop_impl in ("xla", "pallas"), self.pop_impl
         assert self.push_impl in ("xla", "pallas"), self.push_impl
         # The fused pop kernel extracts via the one-hot masked sum only; a

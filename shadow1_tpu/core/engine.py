@@ -102,6 +102,10 @@ class SimState(NamedTuple):
     model: Any              # workload-model pytree
     metrics: Metrics
     cpu_busy: jnp.ndarray   # i64 [H] virtual CPU free-at (host/cpu.c model)
+    # On-device telemetry ring (telemetry/ring.TelemetryRing) or None when
+    # EngineParams.metrics_ring == 0 — None contributes no pytree leaves,
+    # so a ring-less state keeps the historic leaf layout.
+    telem: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -398,7 +402,8 @@ def run_rounds(st: SimState, ctx: Ctx, handlers: dict, win_end):
 
 
 def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
-                pre_window=None, make_handlers=None) -> SimState:
+                pre_window=None, make_handlers=None,
+                telem_reduce=None) -> SimState:
     """One conservative window: inner rounds to quiescence, then delivery.
 
     The batched form of the reference's barrier round
@@ -412,9 +417,14 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
 
     When ``params.compact_cap`` is set (and ``make_handlers`` provided),
     sparse windows run their rounds on a gathered active-host bucket
-    (core/compact.py) — bit-identical results, narrow tensors."""
+    (core/compact.py) — bit-identical results, narrow tensors.
+
+    When the state carries a telemetry ring (``st.telem``), the window's
+    metric deltas are recorded into it here, still inside the trace —
+    ``telem_reduce`` globalizes the row under sharding (telemetry/ring.py)."""
     from shadow1_tpu.core.events import push_impl_ctx, rebase
 
+    metrics_at_entry = st.metrics  # per-window delta baseline (ring)
     win_end = st.win_start + ctx.window
     if pre_window is not None:
         st = pre_window(st, ctx, win_end)
@@ -437,13 +447,20 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
             st, cap_hit = run_rounds(st, ctx, handlers, win_end)
     st = deliver_window(st, ctx, exchange)
     m = st.metrics
-    return st._replace(
+    st = st._replace(
         win_start=win_end,
         metrics=m._replace(
             windows=m.windows + 1,
             round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
         ),
     )
+    if st.telem is not None:
+        from shadow1_tpu.telemetry.ring import ring_record
+
+        st = st._replace(telem=ring_record(
+            st.telem, metrics_at_entry, st.metrics, st.evbuf, telem_reduce
+        ))
+    return st
 
 
 _QLEN_INF = 1 << 62
@@ -586,6 +603,8 @@ class Engine:
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.ring import ring_init
+
         evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
         model, evbuf, seed_over = self._model.init(self.ctx, evbuf)
         metrics = _metrics_init()
@@ -596,6 +615,7 @@ class Engine:
             model=model,
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
+            telem=ring_init(self.params.metrics_ring),
         )
 
     # -- window step pieces ----------------------------------------------

@@ -22,18 +22,30 @@ import time
 LEVELS = {"error": 40, "warning": 30, "message": 20, "info": 10, "debug": 0}
 
 
+def _level_value(level: str) -> int:
+    """LEVELS lookup that fails usefully — the reference's --log-level flag
+    rejects unknown names with the valid set, not a bare KeyError."""
+    try:
+        return LEVELS[level]
+    except KeyError:
+        raise ValueError(
+            f"unknown log level {level!r}; valid levels: "
+            f"{', '.join(LEVELS)}"
+        ) from None
+
+
 class SimLogger:
     """JSON-lines logger with level filtering and sim-time context."""
 
     def __init__(self, stream=None, level: str = "message"):
         self.stream = stream if stream is not None else sys.stderr
-        self.threshold = LEVELS[level]
+        self.threshold = _level_value(level)
         self.t0 = time.perf_counter()
         self.n_dropped = 0
 
     def log(self, level: str, msg: str, sim_ns: int | None = None,
             host: int | None = None, **fields) -> None:
-        if LEVELS[level] < self.threshold:
+        if _level_value(level) < self.threshold:
             self.n_dropped += 1
             return
         rec = {
@@ -86,8 +98,10 @@ def tracker_records(engine, st) -> list[dict]:
         v = np.asarray(v)
         if v.ndim == 1 and v.shape[0] == engine.exp.n_hosts:
             cols[k] = v
+    from shadow1_tpu.telemetry.registry import REC_TRACKER
+
     return [
-        {"type": "tracker", "sim_s": round(sim_ns / 1e9, 6), "host": h,
+        {"type": REC_TRACKER, "sim_s": round(sim_ns / 1e9, 6), "host": h,
          **{k: int(v[h]) for k, v in cols.items()}}
         for h in range(engine.exp.n_hosts)
     ]

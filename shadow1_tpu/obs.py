@@ -6,6 +6,13 @@ derivable (src/main/host/tracker.c, SURVEY §5). The batched analogue: run
 the window loop in chunks and emit one structured heartbeat per chunk with
 the metric deltas — events/sec, packets, retransmits, overflow counters —
 without ever synchronizing device→host inside a window.
+
+Layered on top (round 6, docs/OBSERVABILITY.md): when the engine state
+carries an on-device telemetry ring (EngineParams.metrics_ring), the
+heartbeat also drains the ring's per-window rows at each chunk boundary —
+the true per-window time series underneath the chunk averages — and a
+telemetry.PhaseProfiler can be attached to time the compile / run-chunk /
+drain / checkpoint phases into a Chrome trace.
 """
 
 from __future__ import annotations
@@ -17,44 +24,80 @@ import time
 
 from shadow1_tpu.ckpt import run_chunked
 from shadow1_tpu.consts import SEC
+from shadow1_tpu.telemetry import (
+    PH_CHECKPOINT,
+    PH_COMPILE,
+    PH_DRAIN,
+    maybe_span,
+    normalize,
+)
+
+
+def _metrics_mapping(metrics) -> dict:
+    """Engine metrics → plain int dict (Metrics NamedTuple or already a dict
+    — alternate engines need not mimic the NamedTuple)."""
+    d = metrics if isinstance(metrics, dict) else metrics._asdict()
+    return {k: int(v) for k, v in d.items()}
 
 
 class Heartbeat:
-    """Collects per-chunk metric deltas; writes JSON lines to ``stream``."""
+    """Collects per-chunk metric deltas; writes JSON lines to ``stream``.
+
+    Metric dicts are normalized through the telemetry registry, so engines
+    whose metrics lack canonical fields (cpu_engine, future models) reuse
+    the heartbeat unchanged — missing counters read as 0, never KeyError.
+    """
 
     def __init__(self, engine, stream=None, label: str = "heartbeat",
-                 initial_state=None):
+                 initial_state=None, profiler=None,
+                 emit_heartbeat: bool = True, emit_ring: bool = True):
         self.engine = engine
         self.stream = stream if stream is not None else sys.stderr
         self.label = label
+        self.profiler = profiler
+        self.emit_heartbeat = emit_heartbeat
+        self.emit_ring = emit_ring
         self.t_start = time.perf_counter()
         self.t_last = self.t_start
         # Seed the baseline from a resumed state so the first delta covers
         # only this invocation, not the checkpointed history.
         self.last: dict[str, int] = (
-            {k: int(v) for k, v in initial_state.metrics._asdict().items()}
+            normalize(_metrics_mapping(initial_state.metrics))
             if initial_state is not None else {}
         )
+        # First ring window still undrained (resume-aware like ``last``).
+        self._ring_next: int = self.last.get("windows", 0)
         self.records: list[dict] = []
+        self.ring_records: list[dict] = []
+
+    def _emit(self, rec: dict) -> None:
+        if self.stream:
+            print(json.dumps(rec), file=self.stream, flush=True)
 
     def __call__(self, st, done_windows: int) -> None:
         now = time.perf_counter()
-        m = {k: int(v) for k, v in st.metrics._asdict().items()}
+        # The ONE device→host fetch of the chunk (never inside a window).
+        with maybe_span(self.profiler, PH_DRAIN):
+            m = normalize(_metrics_mapping(st.metrics))
+            ring_recs = self._drain_ring(st)
         delta = {k: v - self.last.get(k, 0) for k, v in m.items()}
         dt = now - self.t_last
         sim_ns = int(st.win_start)  # the true sim clock (resume-aware)
+        d_windows = delta.get("windows", 0)
         rec = {
             "type": self.label,
             "sim_time_s": round(sim_ns / SEC, 6),
             "wall_s": round(now - self.t_start, 3),
             "windows": done_windows,
-            "events_per_sec": round(delta["events"] / dt, 1) if dt > 0 else None,
-            "sim_per_wall": round((self.engine.window * delta["windows"] / SEC) / dt, 4)
+            "events_per_sec": round(delta.get("events", 0) / dt, 1)
+            if dt > 0 else None,
+            "sim_per_wall": round(
+                (getattr(self.engine, "window", 0) * d_windows / SEC) / dt, 4)
             if dt > 0 else None,
             # Occupancy: how many handler rounds the busiest host forced per
             # window this chunk (the per-window fixed-cost multiplier).
-            "rounds_per_window": round(delta["rounds"] / delta["windows"], 2)
-            if delta.get("windows") else None,
+            "rounds_per_window": round(delta.get("rounds", 0) / d_windows, 2)
+            if d_windows else None,
             "delta": delta,
         }
         # Exchange occupancy (sharded engine): how close the busiest
@@ -69,14 +112,29 @@ class Heartbeat:
             }
             delta.pop("x2x_max_fill", None)  # a high-water mark, not a rate
         self.records.append(rec)
-        if self.stream:
-            print(json.dumps(rec), file=self.stream, flush=True)
+        if self.emit_heartbeat:
+            self._emit(rec)
+        for r in ring_recs:
+            self.ring_records.append(r)
+            if self.emit_ring:
+                self._emit(r)
         self.t_last = now
         self.last = m
 
+    def _drain_ring(self, st) -> list[dict]:
+        """Per-window ring rows accumulated since the last chunk boundary."""
+        if getattr(st, "telem", None) is None:
+            return []
+        from shadow1_tpu.telemetry.ring import drain_ring
+
+        recs = drain_ring(st, self.engine.window, start=self._ring_next)
+        self._ring_next = int(st.metrics.windows)
+        return recs
+
 
 def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
-                       stream=None, ckpt_path=None, ckpt_every_s=120.0):
+                       stream=None, ckpt_path=None, ckpt_every_s=120.0,
+                       profiler=None, emit_heartbeat=True, emit_ring=True):
     """Run the engine emitting a heartbeat every ``every_windows`` windows.
 
     With ``ckpt_path``, engine state is snapshotted there at heartbeat
@@ -87,25 +145,34 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     respawn a fresh process that resumes from the snapshot (cli.py --ckpt).
     Determinism makes the resumed run bit-identical to an uninterrupted one.
 
-    Returns (final_state, heartbeat) — heartbeat.records holds the stream.
+    With ``profiler`` (telemetry.PhaseProfiler), the compile warmup, every
+    run-chunk, every chunk-boundary drain and every checkpoint save are
+    recorded as Chrome-trace spans (CLI --trace).
+
+    Returns (final_state, heartbeat) — heartbeat.records holds the stream,
+    heartbeat.ring_records the drained per-window telemetry rows.
     """
     import jax
 
     from shadow1_tpu import ckpt as _ckpt
+    from shadow1_tpu.telemetry import PH_INIT
 
     total = n_windows if n_windows is not None else engine.n_windows
     if every_windows is None:
         every_windows = max(total // 10, 1)
     if st is None:
-        st = engine.init_state()
+        with maybe_span(profiler, PH_INIT):
+            st = engine.init_state()
     # Compile before the clock starts: n_windows is a traced argument, so a
     # zero-window call builds the exact program every chunk reuses — the
     # first heartbeat's events/sec no longer folds compile time in.
-    jax.block_until_ready(engine.run(st, n_windows=0))
-    hb = Heartbeat(engine, stream=stream, initial_state=st)
+    with maybe_span(profiler, PH_COMPILE):
+        jax.block_until_ready(engine.run(st, n_windows=0))
+    hb = Heartbeat(engine, stream=stream, initial_state=st, profiler=profiler,
+                   emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
     if ckpt_path is None:
         st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                         on_chunk=hb)
+                         on_chunk=hb, profiler=profiler)
         return st, hb
 
     last_save = time.perf_counter()
@@ -115,17 +182,18 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
         hb(s, done)
         now = time.perf_counter()
         if done >= total or now - last_save > ckpt_every_s:
-            _ckpt.save_state(s, ckpt_path)
-            # win_start is the absolute sim clock — monotonic across
-            # respawned processes, unlike the invocation-relative ``done``.
-            # Atomic like save_state: a wedge mid-write must not leave a
-            # truncated sidecar that makes the supervisor abandon a
-            # perfectly resumable snapshot.
-            tmp = ckpt_path + ".progress.tmp"
-            with open(tmp, "w") as f:
-                json.dump({"done_windows": done, "total": total,
-                           "win_start": int(s.win_start)}, f)
-            os.replace(tmp, ckpt_path + ".progress")
+            with maybe_span(profiler, PH_CHECKPOINT):
+                _ckpt.save_state(s, ckpt_path)
+                # win_start is the absolute sim clock — monotonic across
+                # respawned processes, unlike the invocation-relative
+                # ``done``. Atomic like save_state: a wedge mid-write must
+                # not leave a truncated sidecar that makes the supervisor
+                # abandon a perfectly resumable snapshot.
+                tmp = ckpt_path + ".progress.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"done_windows": done, "total": total,
+                               "win_start": int(s.win_start)}, f)
+                os.replace(tmp, ckpt_path + ".progress")
             last_save = now
             # Fault injection (SURVEY §5 failure-detection analogue): die
             # like a wedged device process at an exact sim time, once — a
@@ -136,5 +204,5 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                 os._exit(41)
 
     st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                     on_chunk=on_chunk)
+                     on_chunk=on_chunk, profiler=profiler)
     return st, hb

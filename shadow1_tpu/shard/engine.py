@@ -64,6 +64,20 @@ from shadow1_tpu.core.events import _hi, _join, _lo, evbuf_init
 from shadow1_tpu.core.outbox import outbox_init
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level API (with its
+    check_vma flag) when present, else the experimental one (check_rep).
+    Replication checking is off either way — the metrics psum pattern
+    intentionally returns locally-diverged values under replicated specs."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
 class ShardedEngine:
     """Engine running one CompiledExperiment over an n-device host-axis mesh.
 
@@ -136,10 +150,19 @@ class ShardedEngine:
         return P()
 
     def _state_specs(self, st: SimState):
-        return jax.tree.map(self._spec_for, st)
+        # The telemetry ring is [W, F] with NO host axis — replicated like
+        # win_start (window_step globalizes each row via telem_reduce).
+        # Spec'd explicitly so a ring whose trailing dim happens to equal
+        # n_hosts can never be mis-sharded by the shape heuristic.
+        specs = jax.tree.map(self._spec_for, st._replace(telem=None))
+        if st.telem is not None:
+            specs = specs._replace(telem=jax.tree.map(lambda _: P(), st.telem))
+        return specs
 
     # -- state -------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.ring import ring_init
+
         evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
         model, evbuf, seed_over = self._model.init(self.global_ctx, evbuf)
         metrics = _metrics_init()
@@ -150,6 +173,7 @@ class ShardedEngine:
             model=model,
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
+            telem=ring_init(self.params.metrics_ring),
         )
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self._state_specs(st)
@@ -300,11 +324,22 @@ class ShardedEngine:
                 )
                 return out, dropped, fill_hw
 
+            def telem_reduce(counters, fill):
+                # Globalize one ring row: counter deltas are additive across
+                # shards (psum); the evbuf fill gauge needs a max, carried by
+                # the same psum'd one-hot [n_dev] trick as the exchange
+                # high-water (sum-only collectives — the axon tunnel's AOT
+                # compiler lowers no pmax, measured round 5).
+                slot = jnp.arange(n_dev) == jax.lax.axis_index(axis)
+                fill_vec = jax.lax.psum(jnp.where(slot, fill, 0), axis)
+                return jax.lax.psum(counters, axis), fill_vec.max()
+
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
                 0, n_windows,
                 lambda _, s: window_step(s, ctx, handlers, exchange, pre_window,
-                                         make_handlers=model.make_handlers),
+                                         make_handlers=model.make_handlers,
+                                         telem_reduce=telem_reduce),
                 st,
             )
             # Each shard accumulated its own partials on top of the (replicated)
@@ -325,12 +360,11 @@ class ShardedEngine:
         def run(st: SimState, n_windows) -> SimState:
             specs = self._state_specs(st)
             col_specs = {k: P(axis) for k in cols_g}
-            f = jax.shard_map(
+            f = _shard_map(
                 block,
                 mesh=self.mesh,
                 in_specs=(specs, col_specs, P()),
                 out_specs=specs,
-                check_vma=False,
             )
             return f(st, cols_g, n_windows)
 

@@ -1,0 +1,36 @@
+"""Telemetry plane: on-device metrics ring, phase profiler, metrics registry.
+
+Three coordinated observability pieces (see docs/OBSERVABILITY.md):
+
+* ``telemetry.ring`` — per-window counter deltas recorded on device inside
+  the jitted window loop, drained at chunk boundaries (the true time series
+  the chunk-averaged heartbeat cannot provide);
+* ``telemetry.profiler`` — host-side phase spans exported as Chrome
+  trace-event JSON (Perfetto-viewable);
+* ``telemetry.registry`` — the one named-counter namespace shared by the
+  tpu, sharded and cpu engines, with Prometheus text exposition and the
+  JSONL record schema.
+
+``registry`` is jax-free and safe for tools; ``ring`` pulls in jax — import
+it lazily from host-only paths.
+"""
+
+from shadow1_tpu.telemetry.profiler import (  # noqa: F401
+    PH_CHECKPOINT,
+    PH_COMPILE,
+    PH_DRAIN,
+    PH_INIT,
+    PH_RUN_CHUNK,
+    PhaseProfiler,
+    maybe_span,
+)
+from shadow1_tpu.telemetry.registry import (  # noqa: F401
+    METRIC_SPECS,
+    RECORD_TYPES,
+    RING_COUNTERS,
+    RING_FIELDS,
+    RING_GAUGES,
+    ExpositionServer,
+    normalize,
+    to_prometheus,
+)

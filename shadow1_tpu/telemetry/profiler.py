@@ -1,0 +1,111 @@
+"""Host-side phase profiler — Chrome trace-event export.
+
+The reference's heartbeat rows carry wall time next to sim time so the
+sim/wall ratio and its phases are derivable from the log (SURVEY §5); the
+batched rebuild's phases are coarser — compile, init, run-chunk, drain,
+checkpoint — and the question a perf PR actually asks is "where did the
+wall clock go between heartbeats?". This profiler answers it with near-zero
+overhead: a ``with profiler.span("run-chunk"):`` records one complete
+("ph": "X") trace event; ``write(path)`` emits Chrome trace-event JSON
+that chrome://tracing and Perfetto (https://ui.perfetto.dev) load directly.
+
+Not a replacement for ``--profile`` (the jax/XLA op-level profiler): this
+is the cheap always-on layer above it, one event per phase rather than per
+op, safe to leave enabled on production runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+# Canonical phase names (docs/OBSERVABILITY.md) — free-form names are
+# allowed, but the wired-in call sites use these.
+PH_COMPILE = "compile"
+PH_INIT = "init"
+PH_RUN_CHUNK = "run-chunk"
+PH_DRAIN = "drain"
+PH_CHECKPOINT = "checkpoint"
+
+
+class PhaseProfiler:
+    """Collects complete-span trace events; thread-safe, append-only."""
+
+    def __init__(self, process_name: str = "shadow1_tpu"):
+        self.t0 = time.perf_counter()
+        self.process_name = process_name
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self.t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """Time a phase: ``with prof.span("run-chunk", windows=128): ...``"""
+        t_start = self._now_us()
+        try:
+            yield self
+        finally:
+            t_end = self._now_us()
+            ev = {
+                "name": name,
+                "ph": "X",
+                "ts": round(t_start, 1),
+                "dur": round(t_end - t_start, 1),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() & 0xFFFFFFFF,
+            }
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self.events.append(ev)
+
+    def instant(self, name: str, **args) -> None:
+        """Mark a point in time (``"ph": "i"`` instant event)."""
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "p",  # process-scoped instant
+            "ts": round(self._now_us(), 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object (dict form)."""
+        meta = [{
+            "name": "process_name",
+            "ph": "M",
+            "pid": os.getpid(),
+            "tid": 0,
+            "args": {"name": self.process_name},
+        }]
+        with self._lock:
+            events = meta + list(self.events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> None:
+        """Write the trace JSON (atomic: tmp + rename, like ckpt saves)."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        os.replace(tmp, path)
+
+    def span_names(self) -> list[str]:
+        with self._lock:
+            return [e["name"] for e in self.events if e.get("ph") == "X"]
+
+
+def maybe_span(profiler: PhaseProfiler | None, name: str, **args):
+    """``profiler.span(...)`` or a nullcontext — call sites stay branchless."""
+    if profiler is None:
+        return contextlib.nullcontext()
+    return profiler.span(name, **args)

@@ -1,0 +1,200 @@
+"""Unified metrics registry — one named-counter namespace for every engine.
+
+The reference keeps its counter taxonomy in one place (the Tracker's
+interval columns, src/main/host/tracker.c) and every consumer — the
+heartbeat log, the tools scripts — reads that one schema. Our rebuild had
+grown three ad-hoc dict shapes instead: the TPU engines' ``Metrics``
+NamedTuple, the CPU engine's plain dict (a key subset), and whatever
+``tools/heartbeat_report.py`` guessed from the JSONL. This module is the
+single source of truth the three now share:
+
+* ``METRIC_SPECS`` — the canonical counter namespace: name → (kind, help).
+  ``tests/test_telemetry.py`` asserts it stays in sync with the engine's
+  ``Metrics._fields`` so the namespaces cannot drift.
+* ``normalize(d)`` — project any engine's metrics dict onto the canonical
+  namespace (missing counters → 0, unknown extras preserved), so the
+  heartbeat and the report never KeyError on an engine that lacks a field.
+* ``to_prometheus(d)`` — Prometheus text exposition (counters get the
+  ``_total`` suffix, gauges don't), servable via ``ExpositionServer``.
+* the JSONL record-type constants (``REC_*``) and the ring column schema
+  (``RING_FIELDS``) every stream producer/consumer shares
+  (see docs/OBSERVABILITY.md for the concrete record shapes).
+
+Deliberately jax-free: tools and report scripts import it without paying
+an accelerator-runtime import.
+"""
+
+from __future__ import annotations
+
+import threading
+
+COUNTER = "counter"
+GAUGE = "gauge"
+
+# name → (kind, help). Order is the canonical export order.
+METRIC_SPECS: dict[str, tuple[str, str]] = {
+    "events": (COUNTER, "events executed"),
+    "rounds": (COUNTER, "inner scheduler rounds run (batch engines)"),
+    "windows": (COUNTER, "conservative windows completed"),
+    "pkts_sent": (COUNTER, "packets routed out of host outboxes"),
+    "pkts_delivered": (COUNTER, "packets scattered into destination event buffers"),
+    "pkts_lost": (COUNTER, "packets dropped by path loss draws"),
+    "ev_overflow": (COUNTER, "events dropped: full event buffer"),
+    "ob_overflow": (COUNTER, "packets dropped: full outbox"),
+    "round_cap_hits": (COUNTER, "windows that hit the max_rounds safety cap"),
+    "tcp_fast_rtx": (COUNTER, "TCP fast-retransmit (3 dup-ACK) episodes"),
+    "tcp_rto": (COUNTER, "TCP retransmit-timeout episodes"),
+    "tcp_ooo_drops": (COUNTER, "out-of-order segments dropped (GBN receiver)"),
+    "x2x_overflow": (COUNTER, "packets dropped: all_to_all bucket full (sharded)"),
+    "x2x_max_fill": (GAUGE, "high-water demanded all_to_all bucket fill"),
+    "down_events": (COUNTER, "events discarded: host stopped (churn)"),
+    "down_pkts": (COUNTER, "packets dropped: destination host stopped"),
+    "nic_tx_drops": (COUNTER, "packets dropped: NIC uplink queue full"),
+    "nic_rx_drops": (COUNTER, "packets dropped: NIC downlink queue full"),
+    "nic_aqm_drops": (COUNTER, "packets dropped: RED early-drop (uplink)"),
+    "pops_pkt": (COUNTER, "K_PKT events popped"),
+    "pops_deliver": (COUNTER, "K_PKT_DELIVER events popped"),
+    "pops_timer": (COUNTER, "K_TCP_TIMER events popped"),
+    "pops_txr": (COUNTER, "K_TX_RESUME events popped"),
+    "pops_app": (COUNTER, "K_APP events popped"),
+    "fires_pkt": (COUNTER, "rounds where the K_PKT pass fired"),
+    "fires_deliver": (COUNTER, "rounds where the K_PKT_DELIVER pass fired"),
+    "fires_timer": (COUNTER, "rounds where the K_TCP_TIMER pass fired"),
+    "fires_txr": (COUNTER, "rounds where the K_TX_RESUME pass fired"),
+    "fires_app": (COUNTER, "rounds where the K_APP pass fired"),
+}
+
+# JSONL record types every consumer recognises (docs/OBSERVABILITY.md).
+REC_HEARTBEAT = "heartbeat"
+REC_TRACKER = "tracker"
+REC_RING = "ring"
+REC_RING_GAP = "ring_gap"
+RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP)
+
+# ---------------------------------------------------------------------------
+# On-device telemetry ring schema (consumed by telemetry/ring.py, which owns
+# the jax side; declared here so report tools stay jax-free).
+# Counter columns are PER-WINDOW DELTAS of the matching METRIC_SPECS
+# counters; gauge columns are per-window occupancy gauges.
+# ---------------------------------------------------------------------------
+RING_COUNTERS = (
+    "events", "rounds", "pkts_sent", "pkts_delivered", "pkts_lost",
+    "ev_overflow", "ob_overflow", "x2x_overflow", "down_events", "down_pkts",
+)
+RING_GAUGES = (
+    "evbuf_fill",     # max pending events on any host at window end
+    "x2x_max_fill",   # running high-water all_to_all bucket demand
+)
+RING_FIELDS = RING_COUNTERS + RING_GAUGES
+
+
+def counter_names() -> tuple[str, ...]:
+    return tuple(n for n, (k, _) in METRIC_SPECS.items() if k == COUNTER)
+
+
+def gauge_names() -> tuple[str, ...]:
+    return tuple(n for n, (k, _) in METRIC_SPECS.items() if k == GAUGE)
+
+
+def normalize(metrics: dict) -> dict[str, int]:
+    """Project ``metrics`` onto the canonical namespace.
+
+    Every canonical counter is present (missing → 0, canonical order);
+    engine-specific extras follow, preserved verbatim — so consumers can
+    index any canonical name without guarding, on any engine's dict."""
+    out = {name: int(metrics.get(name, 0)) for name in METRIC_SPECS}
+    out.update({k: v for k, v in metrics.items() if k not in METRIC_SPECS})
+    return out
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def to_prometheus(metrics: dict, prefix: str = "shadow1",
+                  labels: dict | None = None) -> str:
+    """Prometheus text exposition (version 0.0.4) of a metrics dict.
+
+    Canonical counters are exported as ``<prefix>_<name>_total``, gauges as
+    ``<prefix>_<name>``; unknown extras default to counter kind."""
+    lab = ""
+    if labels:
+        inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                         for k, v in sorted(labels.items()))
+        lab = "{" + inner + "}"
+    lines = []
+    for name, value in normalize(metrics).items():
+        kind, help_ = METRIC_SPECS.get(name, (COUNTER, "engine-specific counter"))
+        metric = f"{prefix}_{name}" + ("_total" if kind == COUNTER else "")
+        lines.append(f"# HELP {metric} {_escape_help(help_)}")
+        lines.append(f"# TYPE {metric} {kind}")
+        lines.append(f"{metric}{lab} {int(value)}")
+    return "\n".join(lines) + "\n"
+
+
+class ExpositionServer:
+    """Minimal Prometheus-style scrape endpoint (GET /metrics).
+
+    ``get_metrics`` is called per scrape and must return a metrics dict —
+    typically ``lambda: Engine.metrics_dict(latest_state)`` refreshed at
+    chunk boundaries, so scraping never touches the device mid-window.
+
+        srv = ExpositionServer(lambda: metrics, port=0)  # 0 = ephemeral
+        srv.start()
+        ... scrape http://127.0.0.1:{srv.port}/metrics ...
+        srv.stop()
+    """
+
+    def __init__(self, get_metrics, port: int = 0, host: str = "127.0.0.1",
+                 prefix: str = "shadow1", labels: dict | None = None):
+        self.get_metrics = get_metrics
+        self._addr = (host, port)
+        self.prefix = prefix
+        self.labels = labels
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ExpositionServer":
+        import http.server
+
+        reg = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    body = to_prometheus(reg.get_metrics(), prefix=reg.prefix,
+                                         labels=reg.labels).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(self._addr, Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

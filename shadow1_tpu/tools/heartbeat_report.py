@@ -1,12 +1,14 @@
-"""Heartbeat/tracker log analysis — the tools/ plotting-scripts analogue.
+"""Heartbeat/tracker/ring log analysis — the tools/ plotting-scripts analogue.
 
 The reference ships helper scripts that parse heartbeat logs into
 throughput/RTT tables and plots (SURVEY §2.6 tools/). This reads the JSON
 lines the CLI emits (--heartbeat → engine heartbeats on stderr; --tracker →
-per-host records) and prints summary tables plus an optional CSV for
-plotting.
+per-host records; --metrics-ring → per-window telemetry rows) and prints
+summary tables plus optional CSVs for plotting. Record schemas are the
+telemetry registry's (docs/OBSERVABILITY.md) — one namespace, not three.
 
     python -m shadow1_tpu.tools.heartbeat_report run.log [--csv out.csv]
+        [--ring-csv ring.csv]
 """
 
 from __future__ import annotations
@@ -14,7 +16,16 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import math
 import sys
+
+from shadow1_tpu.telemetry.registry import (
+    REC_HEARTBEAT,
+    REC_RING,
+    REC_RING_GAP,
+    REC_TRACKER,
+    RING_FIELDS,
+)
 
 
 def load_records(path: str) -> list[dict]:
@@ -31,17 +42,53 @@ def load_records(path: str) -> list[dict]:
     return recs
 
 
-def summarize(recs: list[dict], out=sys.stdout) -> dict:
-    hb = [r for r in recs if r.get("type") == "heartbeat"]
-    tr = [r for r in recs if r.get("type") == "tracker"]
-    summary: dict = {"heartbeats": len(hb), "tracker_records": len(tr)}
+def percentile(values: list, q: float):
+    """Nearest-rank percentile on a small series (no numpy dependency)."""
+    if not values:
+        return None
+    s = sorted(values)
+    idx = math.ceil(q / 100 * len(s)) - 1
+    return s[min(len(s) - 1, max(0, idx))]
+
+
+def ring_summary(rings: list[dict]) -> dict:
+    """Per-window occupancy distribution: p50/p95/max for each ring field.
+
+    This is the table the rung-cap sizing debates need (docs/R6_NOTES.md):
+    the chunk-averaged heartbeat hides the spikes; the ring records them."""
+    out: dict = {"windows": len(rings)}
+    for field in RING_FIELDS:
+        series = [r[field] for r in rings if field in r]
+        if not series:
+            continue
+        out[field] = {
+            "p50": percentile(series, 50),
+            "p95": percentile(series, 95),
+            "max": max(series),
+        }
+    return out
+
+
+def summarize(recs: list[dict], out=None) -> dict:
+    # sys.stdout resolved at call time, not def time — a def-time default
+    # pins whatever stream an importer (e.g. pytest capture) had installed.
+    out = out if out is not None else sys.stdout
+    hb = [r for r in recs if r.get("type") == REC_HEARTBEAT]
+    tr = [r for r in recs if r.get("type") == REC_TRACKER]
+    rings = [r for r in recs if r.get("type") == REC_RING]
+    gaps = [r for r in recs if r.get("type") == REC_RING_GAP]
+    summary: dict = {
+        "heartbeats": len(hb),
+        "tracker_records": len(tr),
+        "ring_records": len(rings),
+    }
     if hb:
         eps = [r["events_per_sec"] for r in hb if r.get("events_per_sec")]
         spw = [r["sim_per_wall"] for r in hb if r.get("sim_per_wall")]
         summary.update(
             sim_time_s=hb[-1]["sim_time_s"],
             wall_s=hb[-1]["wall_s"],
-            events=sum(r["delta"]["events"] for r in hb),
+            events=sum(r["delta"].get("events", 0) for r in hb),
             events_per_sec_mean=round(sum(eps) / len(eps), 1) if eps else None,
             sim_per_wall_mean=round(sum(spw) / len(spw), 4) if spw else None,
             pkts_delivered=sum(r["delta"].get("pkts_delivered", 0) for r in hb),
@@ -53,6 +100,21 @@ def summarize(recs: list[dict], out=sys.stdout) -> dict:
         print("== run summary ==", file=out)
         for k, v in summary.items():
             print(f"  {k}: {v}", file=out)
+    if rings:
+        rs = ring_summary(rings)
+        summary["ring"] = rs
+        print("== per-window occupancy (ring) ==", file=out)
+        print(f"  windows recorded: {rs['windows']}", file=out)
+        if gaps:
+            lost = sum(g.get("windows_lost", 0) for g in gaps)
+            summary["ring_windows_lost"] = lost
+            print(f"  WINDOWS LOST TO RING OVERWRITE: {lost} "
+                  f"(chunk exceeded ring depth)", file=out)
+        for field in RING_FIELDS:
+            if field in rs:
+                d = rs[field]
+                print(f"  {field}: p50 {d['p50']}  p95 {d['p95']}  "
+                      f"max {d['max']}", file=out)
     if tr:
         last_per_host: dict[int, dict] = {}
         for r in tr:
@@ -71,11 +133,38 @@ def summarize(recs: list[dict], out=sys.stdout) -> dict:
     return summary
 
 
+def write_heartbeat_csv(recs: list[dict], path: str) -> None:
+    hb = [r for r in recs if r.get("type") == REC_HEARTBEAT]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["sim_time_s", "wall_s", "events_per_sec",
+                    "sim_per_wall", "events", "pkts_delivered"])
+        for r in hb:
+            delta = r.get("delta", {})
+            w.writerow([
+                r["sim_time_s"], r["wall_s"], r.get("events_per_sec"),
+                r.get("sim_per_wall"), delta.get("events", 0),
+                delta.get("pkts_delivered", 0),
+            ])
+
+
+def write_ring_csv(recs: list[dict], path: str) -> None:
+    rings = [r for r in recs if r.get("type") == REC_RING]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["window", "sim_time_s", *RING_FIELDS])
+        for r in rings:
+            w.writerow([r.get("window"), r.get("sim_time_s"),
+                        *[r.get(field) for field in RING_FIELDS]])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.heartbeat_report")
     ap.add_argument("log")
     ap.add_argument("--csv", default=None,
                     help="write the heartbeat series as CSV for plotting")
+    ap.add_argument("--ring-csv", default=None,
+                    help="write the per-window ring series as CSV")
     args = ap.parse_args(argv)
     recs = load_records(args.log)
     if not recs:
@@ -83,18 +172,11 @@ def main(argv=None) -> int:
         return 1
     summarize(recs)
     if args.csv:
-        hb = [r for r in recs if r.get("type") == "heartbeat"]
-        with open(args.csv, "w", newline="") as f:
-            w = csv.writer(f)
-            w.writerow(["sim_time_s", "wall_s", "events_per_sec",
-                        "sim_per_wall", "events", "pkts_delivered"])
-            for r in hb:
-                w.writerow([
-                    r["sim_time_s"], r["wall_s"], r.get("events_per_sec"),
-                    r.get("sim_per_wall"), r["delta"]["events"],
-                    r["delta"].get("pkts_delivered", 0),
-                ])
+        write_heartbeat_csv(recs, args.csv)
         print(f"wrote {args.csv}")
+    if args.ring_csv:
+        write_ring_csv(recs, args.ring_csv)
+        print(f"wrote {args.ring_csv}")
     return 0
 
 
