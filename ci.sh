@@ -3,7 +3,8 @@
 #
 #   ./ci.sh            fast tier: full suite minus the slow mid-scale tier
 #   ./ci.sh all        everything, including 512–1024-host parity
-#   ./ci.sh smoke      import + config + events only (~seconds)
+#   ./ci.sh smoke      config + events + ckpt/obs/telemetry + tune fast paths
+#                      (tgen-based tune tests stay in the fast/all tiers)
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -13,7 +14,7 @@ cd "$(dirname "$0")"
 
 tier="${1:-fast}"
 case "$tier" in
-  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py -q ;;
+  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py -q -m "not slow" -k "not tgen" ;;
   fast)  exec python -m pytest tests/ -q -m "not slow" ;;
   all)   exec python -m pytest tests/ -q ;;
   *) echo "usage: $0 [smoke|fast|all]" >&2; exit 2 ;;

@@ -26,7 +26,12 @@ import jax
 #      (present only when EngineParams.metrics_ring > 0; a ring-less state
 #      keeps the v4 leaf layout, but the format is bumped so a ring/ring-less
 #      mismatch fails as a version error, not a confusing leaf-count one)
-CKPT_FORMAT = 5
+#   6: capacity autotuning — Metrics gains the ev_max_fill / ob_max_fill /
+#      compact_max_fill gauges, and load_state learns CAP MIGRATION: a
+#      snapshot whose ev_cap/outbox_cap differs from the engine's restores
+#      via tune/resize.py instead of failing the shape check (--auto-caps
+#      runs checkpoint at whatever cap they had grown to)
+CKPT_FORMAT = 6
 
 
 def _flatten(st):
@@ -51,9 +56,17 @@ def save_state(st, path: str) -> None:
     os.replace(tmp, path)
 
 
-def load_state(template, path: str):
+def load_state(template, path: str, migrate_caps: bool = True):
     """Load a snapshot into the structure of ``template`` (a SimState from
-    ``engine.init_state()``) — shapes/dtypes must match the engine config."""
+    ``engine.init_state()``) — shapes/dtypes must match the engine config.
+
+    One sanctioned mismatch: with ``migrate_caps`` (default), a snapshot
+    saved at a different ``ev_cap``/``outbox_cap`` is migrated to the
+    template's caps via tune/resize.py (bit-exact — pop order lives in the
+    (time, tb) keys, not slot indices). This is how an ``--auto-caps`` run's
+    checkpoints — saved at whatever cap the controller had grown to —
+    restore into an engine built from the config's static caps. Every other
+    shape/dtype difference still fails as a config mismatch."""
     tleaves, treedef = _flatten(template)
     with np.load(path) as data:
         fmt = data["format"] if "format" in data.files else np.asarray([1, -1])
@@ -69,7 +82,30 @@ def load_state(template, path: str):
                 f"expects {len(tleaves)} — engine config mismatch"
             )
         leaves = [data[f"leaf_{i}"] for i in range(len(tleaves))]
+    if migrate_caps:
+        # Structure (leaf count) already matched, so the saved leaves
+        # unflatten into a SimState whose planes carry the SAVED caps;
+        # migrate the event buffer / outbox onto the template's caps before
+        # the strict per-leaf validation below.
+        st = jax.tree_util.tree_unflatten(treedef, leaves)
+        ev_cap = np.asarray(template.evbuf.kind).shape[-2]
+        ob_cap = np.asarray(template.outbox.dst).shape[-2]
+        if (np.asarray(st.evbuf.kind).shape[-2] != ev_cap
+                or np.asarray(st.outbox.dst).shape[-2] != ob_cap):
+            from shadow1_tpu.tune.resize import resize_state
+
+            try:
+                st = resize_state(st, ev_cap=ev_cap, outbox_cap=ob_cap)
+            except ValueError as e:
+                raise ValueError(
+                    f"checkpoint {path} cannot migrate onto this engine's "
+                    f"caps ({e}) — rebuild the engine at the snapshot's caps "
+                    f"(ckpt.snapshot_caps) or resume with --auto-caps, which "
+                    f"does this automatically"
+                ) from e
+            leaves = jax.tree_util.tree_leaves(st)
     for i, (have, want) in enumerate(zip(leaves, tleaves)):
+        have = np.asarray(have)
         w = np.asarray(want)
         if have.shape != w.shape or have.dtype != w.dtype:
             raise ValueError(
@@ -79,14 +115,50 @@ def load_state(template, path: str):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def snapshot_caps(template, path: str) -> tuple[int, int] | None:
+    """(ev_cap, outbox_cap) a snapshot was SAVED at, read off its leaf
+    shapes without loading the full state. An ``--auto-caps`` run
+    checkpoints at whatever cap the controller had grown to — possibly
+    holding more events per host than the config's static cap can — so a
+    supervised respawn must rebuild its engine at the snapshot's caps
+    before resuming (cli.py does this; a shrink-on-load that would drop
+    events refuses instead). Returns None when the snapshot's leaf layout
+    doesn't match ``template`` (the format checks in load_state will say
+    why)."""
+    leaves = jax.tree_util.tree_leaves(template)
+
+    def idx(leaf):
+        for i, l in enumerate(leaves):
+            if l is leaf:
+                return i
+        return None
+
+    i_ev = idx(template.evbuf.kind)
+    i_ob = idx(template.outbox.dst)
+    with np.load(path) as data:
+        for i in (i_ev, i_ob):
+            if i is None or f"leaf_{i}" not in data.files:
+                return None
+        ev, ob = data[f"leaf_{i_ev}"].shape, data[f"leaf_{i_ob}"].shape
+        if len(ev) != 2 or len(ob) != 2:
+            return None
+        return int(ev[-2]), int(ob[-2])
+
+
 def run_chunked(engine, st=None, n_windows: int | None = None,
-                chunk: int = 0, on_chunk=None, profiler=None):
+                chunk: int = 0, on_chunk=None, profiler=None, retune=None):
     """Run in fixed-size window chunks, invoking ``on_chunk(st, done)`` after
     each (for checkpoints/heartbeats). One compiled program is reused for
     every full chunk. Returns the final state.
 
     ``profiler`` (telemetry.PhaseProfiler) records one ``run-chunk`` span
-    per chunk — the dominant phase every trace wants resolved."""
+    per chunk — the dominant phase every trace wants resolved.
+
+    ``retune(engine, st) -> (engine, st)`` is the between-chunk adaptation
+    hook (tune/autocap.CapController): it may hand back a DIFFERENT engine
+    (re-jitted at new static capacities) with the state migrated to match.
+    Called after ``on_chunk`` so heartbeats/checkpoints see the state that
+    actually ran the chunk; never called after the final chunk."""
     from shadow1_tpu.telemetry import PH_INIT, PH_RUN_CHUNK, maybe_span
 
     if st is None:
@@ -107,4 +179,6 @@ def run_chunked(engine, st=None, n_windows: int | None = None,
         done += step
         if on_chunk is not None:
             on_chunk(st, done)
+        if retune is not None and done < total:
+            engine, st = retune(engine, st)
     return st

@@ -131,6 +131,13 @@ def main(argv=None) -> int:
                     help="write a Chrome trace-event JSON of the host-side "
                          "phases (compile/init/run-chunk/drain/checkpoint) "
                          "to PATH — load in Perfetto or chrome://tracing")
+    ap.add_argument("--auto-caps", action="store_true",
+                    help="occupancy-driven capacity autotuning: at chunk "
+                         "boundaries, grow ev_cap before overflow and shrink "
+                         "it after sustained low occupancy (measured via the "
+                         "on-device fill gauges), migrating state bit-exactly "
+                         "and re-jitting at ladder-quantized caps "
+                         "(shadow1_tpu/tune/; overrides engine.auto_caps)")
     ap.add_argument("--metrics-ring", type=int, default=None, metavar="W",
                     help="keep a W-window on-device telemetry ring and emit "
                          "one per-window JSONL record to stderr per window "
@@ -150,13 +157,15 @@ def main(argv=None) -> int:
 
         params = dataclasses.replace(params, metrics_ring=args.metrics_ring)
     engine_kind = args.engine or scheduler
+    auto_caps = bool(args.auto_caps or params.auto_caps)
     if engine_kind == "cpu" and (args.save_state or args.resume
                                  or args.heartbeat or args.tracker
                                  or args.profile or args.ckpt
-                                 or args.trace or args.metrics_ring):
+                                 or args.trace or args.metrics_ring
+                                 or args.auto_caps):
         ap.error("--save-state/--resume/--heartbeat/--tracker/--profile/"
-                 "--ckpt/--trace/--metrics-ring require a batched engine "
-                 "(tpu or sharded)")
+                 "--ckpt/--trace/--metrics-ring/--auto-caps require a "
+                 "batched engine (tpu or sharded)")
     if args.ckpt and args.resume and args.windows is not None:
         # Under supervision --windows is the TOTAL for the whole run; under
         # --resume it means N MORE windows. Combining all three makes a
@@ -185,10 +194,19 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     metrics0: dict[str, int] = {}
     resume_path = None
+    controller = None
 
     if engine_kind == "cpu":
         from shadow1_tpu.cpu_engine import CpuEngine
 
+        if auto_caps:
+            # Config-level engine.auto_caps follows the engine.metrics_ring
+            # precedent — inert on the oracle (so a shared config still runs
+            # under --engine cpu) — but say so; the explicit --auto-caps
+            # flag errors above like every other batched-only flag.
+            log.warning("engine.auto_caps ignored: the cpu oracle runs "
+                        "eagerly per event, there is no chunked window loop "
+                        "to retune")
         eng = CpuEngine(exp, params)
         metrics = eng.run(n_windows=args.windows)
         summary = eng.summary()
@@ -209,9 +227,25 @@ def main(argv=None) -> int:
         resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
                        else args.resume)
         if resume_path:
-            from shadow1_tpu.ckpt import load_state
+            from shadow1_tpu.ckpt import load_state, snapshot_caps
 
-            st = load_state(eng.init_state(), resume_path)
+            template = eng.init_state()
+            if auto_caps:
+                # An --auto-caps run checkpoints at whatever cap it had
+                # grown to; a host may hold more events than the config's
+                # static cap, so the respawned engine must START at the
+                # snapshot's caps (the controller re-shrinks later if the
+                # occupancy allows) — otherwise every respawn would die in
+                # the shrink-refuses-to-drop-events check.
+                snap = snapshot_caps(template, resume_path)
+                if snap and snap != (params.ev_cap, params.outbox_cap):
+                    import dataclasses
+
+                    params = dataclasses.replace(
+                        params, ev_cap=snap[0], outbox_cap=snap[1])
+                    eng = Eng(exp, params)
+                    template = eng.init_state()
+            st = load_state(template, resume_path)
             metrics0 = Eng.metrics_dict(st)
             done = int(st.win_start) // exp.window
             if args.windows is None:
@@ -232,11 +266,18 @@ def main(argv=None) -> int:
 
             phases = PhaseProfiler()
         ring_w = params.metrics_ring
+        if auto_caps:
+            from shadow1_tpu.tune import CapController
+
+            controller = CapController(eng, lambda p: Eng(exp, p),
+                                       log=log.info, initial_state=st)
         with prof:
             # phases covers --profile too: its phases.trace.json must carry
             # real spans, so any profiled run routes through the
-            # instrumented chunk runner.
-            if args.heartbeat or args.ckpt or ring_w or phases is not None:
+            # instrumented chunk runner. --auto-caps needs the chunked path
+            # too: resizes happen at chunk boundaries.
+            if (args.heartbeat or args.ckpt or ring_w or phases is not None
+                    or controller is not None):
                 from shadow1_tpu.obs import run_with_heartbeat
 
                 st, _hb = run_with_heartbeat(
@@ -253,6 +294,7 @@ def main(argv=None) -> int:
                     profiler=phases,
                     emit_heartbeat=bool(args.heartbeat),
                     emit_ring=bool(ring_w),
+                    controller=controller,
                 )
             else:
                 st = eng.run(st, n_windows=args.windows)
@@ -292,8 +334,21 @@ def main(argv=None) -> int:
         "sim_per_wall": round(sim_s / wall, 3) if wall > 0 else None,
         "events_per_sec": round(ev_run / wall, 1) if wall > 0 else None,
         "resumed": bool(resume_path),
+        # The caps the run STARTED at — with metrics.ev_max_fill etc. this
+        # is what tools/captune.py computes over-provisioning factors from.
+        "caps": {
+            "ev_cap": params.ev_cap,
+            "outbox_cap": params.outbox_cap,
+            "compact_cap": params.compact_cap,
+        },
         "metrics": {k: int(v) for k, v in metrics.items()},
     }
+    if controller is not None:
+        out["auto_caps"] = {
+            "resizes": controller.resizes,
+            "final": controller.final_caps or {"ev_cap": params.ev_cap,
+                                               "outbox_cap": params.outbox_cap},
+        }
     if args.summary:
         out["summary"] = {
             k: int(v) for k, v in summary.items()

@@ -141,6 +141,15 @@ class EngineParams:
     # the default is layout-identical to a ring-less build. Size it ≥ the
     # heartbeat chunk to get a gap-free time series (CLI --metrics-ring).
     metrics_ring: int = 0
+    # Occupancy-driven capacity autotuning (shadow1_tpu/tune/): 1 = let the
+    # chunk runner resize ev_cap between chunks from the measured high-water
+    # fill gauges (grow before overflow, shrink after sustained low
+    # occupancy; caps quantized to the tune.ladder geometric ladder so the
+    # jit cache stays bounded). CLI --auto-caps overrides. outbox_cap is NOT
+    # auto-resized by default: it is a semantic knob for TCP (tcp_flush
+    # paces on outbox_space), so changing it mid-run changes the event
+    # stream — see tune.autocap.CapPolicy.tune_outbox.
+    auto_caps: int = 0
     # Pop-min result extraction: "sum" (masked-sum over the one-hot — the
     # round-4 default) or "gather" (index via min-over-iota, then
     # take_along_axis — the round-3 style on the round-4 layout). Bit-exact
@@ -172,6 +181,7 @@ class EngineParams:
         assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
         assert self.pop_extract in ("sum", "gather"), self.pop_extract
         assert self.metrics_ring >= 0, self.metrics_ring
+        assert self.auto_caps >= 0, self.auto_caps
         assert self.pop_impl in ("xla", "pallas"), self.pop_impl
         assert self.push_impl in ("xla", "pallas"), self.push_impl
         # The fused pop kernel extracts via the one-hot masked sum only; a

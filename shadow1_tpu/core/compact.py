@@ -118,6 +118,9 @@ def compact_window_rounds(st, ctx, handlers, make_handlers, run_rounds,
     h = ctx.n_hosts
     active = active_mask(st.evbuf, win_end)
     n_active = active.sum(dtype=jnp.int32)
+    # (The demanded-fill gauge ``compact_max_fill`` is recorded by
+    # window_step for every window, compaction on or off — keeping the
+    # compacted and plain engines' states bit-identical.)
 
     def full_branch(st):
         return run_rounds(st, ctx, handlers, win_end)

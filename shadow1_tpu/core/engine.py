@@ -68,6 +68,24 @@ class Metrics(NamedTuple):
                                  # pmax-replicated, so excluded from the
                                  # cross-shard psum like ``windows``) — the
                                  # quantity that rationally pins x2x_cap
+    # Capacity high-water gauges (shadow1_tpu/tune/): run-max window-end
+    # fill of each bounded structure, maintained inside the jitted window
+    # path at one ``max`` per already-computed fill count. These are what
+    # the between-chunk cap controller (tune/autocap.py) and the offline
+    # tuner (tools/captune.py) size caps from. Window-END samples are a
+    # LOWER bound on the true mid-window peak (like tools/occprobe.py) —
+    # the overflow counters stay the authoritative guard. Under sharding
+    # they are max-globalized at chunk end (shard/engine.py), so they match
+    # the single-device values bit-exactly; ``compact_max_fill`` is the one
+    # exception (per-shard bucket demand, like ``rounds``).
+    ev_max_fill: jnp.ndarray      # busiest host's event-slot fill (vs ev_cap)
+    ob_max_fill: jnp.ndarray      # busiest host's per-window outbox fill
+                                  # (vs outbox_cap)
+    compact_max_fill: jnp.ndarray # busiest window's active-host count — the
+                                  # demanded compaction-bucket lanes (vs
+                                  # compact_cap), recorded compaction on OR
+                                  # off so the knob can be sized before it
+                                  # is enabled
     down_events: jnp.ndarray     # events discarded: host stopped (churn)
     down_pkts: jnp.ndarray       # packets dropped: destination host stopped
     nic_tx_drops: jnp.ndarray    # packets dropped: NIC uplink queue full
@@ -361,7 +379,10 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     ``exchange`` maps FlatPackets → (FlatPackets, n_dropped, fill_high_water)
     across the mesh (identity on a single device; a bucketed all_to_all over
     the host axis when sharded — the one collective per window, SURVEY §2.5)."""
+    from shadow1_tpu.core.outbox import outbox_fill
+
     fp, n_sent, n_lost = route_outbox(ctx, st.outbox)
+    ob_fill = outbox_fill(st.outbox)  # maintained [H] counter — before clear
     n_x2x = x2x_hw = jnp.zeros((), jnp.int64)
     if exchange is not None:
         fp, n_x2x, x2x_hw = exchange(fp)
@@ -377,6 +398,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             ev_overflow=m.ev_overflow + n_over,
             x2x_overflow=m.x2x_overflow + n_x2x,
             x2x_max_fill=jnp.maximum(m.x2x_max_fill, x2x_hw),
+            ob_max_fill=jnp.maximum(m.ob_max_fill, ob_fill),
             down_pkts=m.down_pkts + n_down,
         ),
     )
@@ -432,6 +454,16 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     # the round loop below runs i64-free; pre_window and last window's
     # delivery write absolute times only, repaired here).
     st = st._replace(evbuf=rebase(st.evbuf, st.win_start, win_end))
+    # Compaction-bucket demand gauge: this window's active-host count (the
+    # lanes compact_cap must cover), read off the just-rebased [H]
+    # eligibility counters — recorded whether or not compaction is on, so
+    # the knob can be sized BEFORE enabling it, and the compacted and plain
+    # engines stay bit-identical (tests/test_compact.py). Local-block count
+    # under sharding (the per-shard bucket is the resource), like rounds.
+    n_active = (st.evbuf.n_elig > 0).sum(dtype=jnp.int64)
+    m0 = st.metrics
+    st = st._replace(metrics=m0._replace(
+        compact_max_fill=jnp.maximum(m0.compact_max_fill, n_active)))
     ccap = ctx.params.compact_cap
     # push_impl scopes over the round tracing: every handler-layer
     # push_local/push_back below dispatches to the selected implementation
@@ -446,19 +478,26 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
         else:
             st, cap_hit = run_rounds(st, ctx, handlers, win_end)
     st = deliver_window(st, ctx, exchange)
+    # Window-end event-slot occupancy: computed ONCE here (one [C, H] pass
+    # per window, off the round path) and shared by the run-max gauge and
+    # the telemetry ring's per-window column.
+    from shadow1_tpu.core.events import evbuf_fill
+
+    ev_fill = evbuf_fill(st.evbuf)
     m = st.metrics
     st = st._replace(
         win_start=win_end,
         metrics=m._replace(
             windows=m.windows + 1,
             round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
+            ev_max_fill=jnp.maximum(m.ev_max_fill, ev_fill),
         ),
     )
     if st.telem is not None:
         from shadow1_tpu.telemetry.ring import ring_record
 
         st = st._replace(telem=ring_record(
-            st.telem, metrics_at_entry, st.metrics, st.evbuf, telem_reduce
+            st.telem, metrics_at_entry, st.metrics, ev_fill, telem_reduce
         ))
     return st
 
@@ -617,6 +656,12 @@ class Engine:
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
         )
+
+    def place_state(self, st: SimState) -> SimState:
+        """Put a (host-built) state pytree on this engine's devices — the
+        hook the cap controller uses after a tune/resize.py migration.
+        Single-device: a plain transfer."""
+        return jax.device_put(st)
 
     # -- window step pieces ----------------------------------------------
     def _window_step(self, st: SimState) -> SimState:

@@ -315,6 +315,16 @@ def any_eligible(buf: EventBuf, until) -> jnp.ndarray:
     return (buf.n_elig > 0).any()
 
 
+def evbuf_fill(buf: EventBuf) -> jnp.ndarray:
+    """Occupancy gauge: pending events on the busiest host, i64 scalar.
+
+    One [C, H] plane pass — read at WINDOW granularity only (the engine's
+    window-end gauge update and the telemetry ring share one evaluation),
+    never in the round loop. Slot-layout-independent: it counts occupied
+    slots, so a cap migration (tune/resize.py) cannot change it."""
+    return (buf.kind != K_NONE).sum(axis=0, dtype=jnp.int32).max().astype(jnp.int64)
+
+
 def deliver_batch(buf: EventBuf, dst, time, tb, kind, p, mask) -> tuple[EventBuf, jnp.ndarray]:
     """Merge N externally-created events into their hosts' buffers.
 

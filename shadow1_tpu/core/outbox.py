@@ -62,6 +62,12 @@ def outbox_space(ob: Outbox) -> jnp.ndarray:
     return ob.dst.shape[0] - ob.cnt
 
 
+def outbox_fill(ob: Outbox) -> jnp.ndarray:
+    """Occupancy gauge: this window's fill on the busiest host, i64 scalar.
+    Reads the maintained [H] counter — free; read before ``outbox_clear``."""
+    return ob.cnt.max().astype(jnp.int64)
+
+
 def outbox_append(ob: Outbox, mask, dst, kind, depart, p) -> tuple[Outbox, jnp.ndarray]:
     """Append one packet per host where ``mask``. Returns (ob, ok_mask).
 

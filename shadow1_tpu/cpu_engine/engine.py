@@ -86,7 +86,16 @@ class CpuEngine:
             "pops_timer": 0,
             "pops_txr": 0,
             "pops_app": 0,
+            # Capacity high-water gauges, mirroring the batch engines'
+            # window-end sampling (core/engine.py window_step): the boundary
+            # pending-event sets are engine-independent (all events created
+            # before a window boundary with time ≥ it — eager delivery vs
+            # window-end scatter land the same sets), so these match the TPU
+            # gauges bit-exactly on overflow-free runs.
+            "ev_max_fill": 0,
+            "ob_max_fill": 0,
         }
+        self._next_boundary = self.window  # first window-end sample point
         # Per-kind pop occupancy fields (shared table — consts).
         self._pops_field = {k: f[0] for k, f in KIND_METRIC_FIELDS.items()}
         self.model = self._make_model()
@@ -128,6 +137,8 @@ class CpuEngine:
             self.metrics["ob_overflow"] += 1
             return False
         self._ob_used[src] += 1
+        if int(self._ob_used[src]) > self.metrics["ob_max_fill"]:
+            self.metrics["ob_max_fill"] = int(self._ob_used[src])
         ctr = int(self.pkt_ctr[src])
         self.pkt_ctr[src] += 1
         self.metrics["pkts_sent"] += 1
@@ -168,11 +179,25 @@ class CpuEngine:
         heapq.heappush(self.heap, (time, tb, self._gseq, host, kind, p))
         self._gseq += 1
 
+    def _sample_fill(self, upto: int) -> None:
+        """Window-end occupancy samples for every boundary ≤ ``upto``
+        (exclusive of later ones): between two events the pending sets are
+        static, so sampling when the next event's time crosses a boundary
+        sees exactly the state the batch engine gauges at window end."""
+        if self._next_boundary > upto:
+            return
+        fill = int(self.pending.max()) if self.pending.size else 0
+        if fill > self.metrics["ev_max_fill"]:
+            self.metrics["ev_max_fill"] = fill
+        n_skipped = (upto - self._next_boundary) // self.window + 1
+        self._next_boundary += n_skipped * self.window
+
     # -- main loop ---------------------------------------------------------
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
         end = (self.n_windows if n_windows is None else n_windows) * self.window
         rx_batch = getattr(self.model, "rx_batch", False)
         while self.heap and self.heap[0][0] < end:
+            self._sample_fill(int(self.heap[0][0]))
             time, tb, _g, host, kind, p = heapq.heappop(self.heap)
             self.pending[host] -= 1
             # churn: a stopped host discards its events (core run_round rule)
@@ -202,6 +227,8 @@ class CpuEngine:
             if f:
                 self.metrics[f] += 1
             self.model.handle(host, time, kind, p)
+        # Remaining boundaries up to the run end see a static pending set.
+        self._sample_fill(end)
         return dict(self.metrics)
 
     def summary(self) -> dict[str, Any]:

@@ -100,6 +100,21 @@ class Heartbeat:
             if d_windows else None,
             "delta": delta,
         }
+        # Capacity occupancy: run-max fill gauges against their caps — the
+        # data the cap controller and tools/captune.py size caps from.
+        # High-water marks, not rates: they leave ``delta`` and ride a
+        # ``fill`` block with the caps they are measured against.
+        params = getattr(self.engine, "params", None)
+        fill = {}
+        for gauge, cap_field in (("ev_max_fill", "ev_cap"),
+                                 ("ob_max_fill", "outbox_cap"),
+                                 ("compact_max_fill", "compact_cap")):
+            if delta.pop(gauge, 0) or m.get(gauge):
+                fill[gauge] = m.get(gauge)
+                if params is not None:
+                    fill[cap_field] = getattr(params, cap_field)
+        if fill:
+            rec["fill"] = fill
         # Exchange occupancy (sharded engine): how close the busiest
         # all_to_all bucket has come to its cap — the datum that pins
         # x2x_cap rationally (a high-water near cap predicts overflow).
@@ -134,7 +149,8 @@ class Heartbeat:
 
 def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                        stream=None, ckpt_path=None, ckpt_every_s=120.0,
-                       profiler=None, emit_heartbeat=True, emit_ring=True):
+                       profiler=None, emit_heartbeat=True, emit_ring=True,
+                       controller=None):
     """Run the engine emitting a heartbeat every ``every_windows`` windows.
 
     With ``ckpt_path``, engine state is snapshotted there at heartbeat
@@ -148,6 +164,11 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     With ``profiler`` (telemetry.PhaseProfiler), the compile warmup, every
     run-chunk, every chunk-boundary drain and every checkpoint save are
     recorded as Chrome-trace spans (CLI --trace).
+
+    With ``controller`` (tune.CapController — CLI --auto-caps), buffer caps
+    adapt between chunks: the controller may swap in an engine re-jitted at
+    new static capacities with the state migrated bit-exactly; subsequent
+    heartbeats report the live engine's caps.
 
     Returns (final_state, heartbeat) — heartbeat.records holds the stream,
     heartbeat.ring_records the drained per-window telemetry rows.
@@ -170,9 +191,15 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
         jax.block_until_ready(engine.run(st, n_windows=0))
     hb = Heartbeat(engine, stream=stream, initial_state=st, profiler=profiler,
                    emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
+    retune = None
+    if controller is not None:
+        def retune(eng_cur, s):
+            eng_new, s = controller(eng_cur, s)
+            hb.engine = eng_new  # heartbeat caps track the live engine
+            return eng_new, s
     if ckpt_path is None:
         st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                         on_chunk=hb, profiler=profiler)
+                         on_chunk=hb, profiler=profiler, retune=retune)
         return st, hb
 
     last_save = time.perf_counter()
@@ -204,5 +231,5 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                 os._exit(41)
 
     st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                     on_chunk=on_chunk, profiler=profiler)
+                     on_chunk=on_chunk, profiler=profiler, retune=retune)
     return st, hb

@@ -175,6 +175,13 @@ class ShardedEngine:
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
         )
+        return self.place_state(st)
+
+    def place_state(self, st: SimState) -> SimState:
+        """Shard a (host-built) state pytree over the mesh — used at init
+        and after a tune/resize.py cap migration (the migrated planes are
+        plain numpy; the specs are shape-derived, so a new cap reshards
+        correctly)."""
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self._state_specs(st)
         )
@@ -324,15 +331,22 @@ class ShardedEngine:
                 )
                 return out, dropped, fill_hw
 
-            def telem_reduce(counters, fill):
-                # Globalize one ring row: counter deltas are additive across
-                # shards (psum); the evbuf fill gauge needs a max, carried by
-                # the same psum'd one-hot [n_dev] trick as the exchange
-                # high-water (sum-only collectives — the axon tunnel's AOT
-                # compiler lowers no pmax, measured round 5).
+            def pmax_(x):
+                # max across shards of a scalar or [G] vector, carried by a
+                # psum'd one-hot [n_dev, ...] (sum-only collectives — the
+                # axon tunnel's AOT compiler lowers no pmax, measured
+                # round 5).
                 slot = jnp.arange(n_dev) == jax.lax.axis_index(axis)
-                fill_vec = jax.lax.psum(jnp.where(slot, fill, 0), axis)
-                return jax.lax.psum(counters, axis), fill_vec.max()
+                x = jnp.asarray(x)
+                shaped = slot.reshape((n_dev,) + (1,) * x.ndim)
+                vec = jax.lax.psum(jnp.where(shaped, x[None], 0), axis)
+                return vec.max(axis=0)
+
+            def telem_reduce(counters, gauges):
+                # Globalize one ring row: counter deltas are additive across
+                # shards (psum); the occupancy gauge vector needs an
+                # elementwise max.
+                return jax.lax.psum(counters, axis), pmax_(gauges)
 
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
@@ -351,10 +365,19 @@ class ShardedEngine:
             )
             # ``windows`` advances identically on every shard (replicated, like
             # win_start) — keep the local count rather than the 8× sum; same
-            # for the pmax-replicated exchange high-water mark.
+            # for the pmax-replicated exchange high-water mark. The capacity
+            # gauges accumulated per-shard LOCAL maxima inside the loop; one
+            # cross-shard max here makes them the global run high-water —
+            # bit-identical to the single-device values (max of per-window
+            # maxes commutes). compact_max_fill stays a per-shard bucket
+            # gauge semantically (like ``rounds``), but the max over shards
+            # is exactly the number that sizes the per-shard bucket.
             return st._replace(metrics=mfin._replace(
                 windows=st.metrics.windows,
                 x2x_max_fill=st.metrics.x2x_max_fill,
+                ev_max_fill=pmax_(st.metrics.ev_max_fill),
+                ob_max_fill=pmax_(st.metrics.ob_max_fill),
+                compact_max_fill=pmax_(st.metrics.compact_max_fill),
             ))
 
         def run(st: SimState, n_windows) -> SimState:

@@ -47,6 +47,11 @@ METRIC_SPECS: dict[str, tuple[str, str]] = {
     "tcp_ooo_drops": (COUNTER, "out-of-order segments dropped (GBN receiver)"),
     "x2x_overflow": (COUNTER, "packets dropped: all_to_all bucket full (sharded)"),
     "x2x_max_fill": (GAUGE, "high-water demanded all_to_all bucket fill"),
+    "ev_max_fill": (GAUGE, "high-water window-end event-slot fill (vs ev_cap)"),
+    "ob_max_fill": (GAUGE, "high-water per-window outbox fill (vs outbox_cap)"),
+    "compact_max_fill": (GAUGE, "high-water window active-host count: demanded "
+                                "compaction-bucket lanes (vs compact_cap; "
+                                "per-shard block count under sharding)"),
     "down_events": (COUNTER, "events discarded: host stopped (churn)"),
     "down_pkts": (COUNTER, "packets dropped: destination host stopped"),
     "nic_tx_drops": (COUNTER, "packets dropped: NIC uplink queue full"),
@@ -82,8 +87,11 @@ RING_COUNTERS = (
     "ev_overflow", "ob_overflow", "x2x_overflow", "down_events", "down_pkts",
 )
 RING_GAUGES = (
-    "evbuf_fill",     # max pending events on any host at window end
-    "x2x_max_fill",   # running high-water all_to_all bucket demand
+    "evbuf_fill",       # max pending events on any host at window end
+    "ev_max_fill",      # running high-water of evbuf_fill (vs ev_cap)
+    "ob_max_fill",      # running high-water per-window outbox fill
+    "compact_max_fill", # running high-water compaction-bucket demand
+    "x2x_max_fill",     # running high-water all_to_all bucket demand
 )
 RING_FIELDS = RING_COUNTERS + RING_GAUGES
 

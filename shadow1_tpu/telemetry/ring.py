@@ -8,7 +8,7 @@ mean. The ring fixes that without reintroducing mid-window host syncs:
 
 * a device-resident ``[W, F]`` i64 buffer rides in ``SimState.telem``;
 * at the end of every conservative window the engine writes one row —
-  per-window DELTAS of the core counters plus two occupancy gauges
+  per-window DELTAS of the core counters plus the occupancy gauges
   (``registry.RING_FIELDS`` order) — at slot ``window % W``, entirely
   inside the jitted window loop (one dynamic_update_slice, no sync);
 * at chunk boundaries the host drains the rows that accumulated since the
@@ -61,29 +61,29 @@ def ring_init(n_windows: int) -> TelemetryRing | None:
     )
 
 
-def evbuf_fill(evbuf) -> jnp.ndarray:
-    """Occupancy gauge: pending events on the busiest host (local block)."""
-    return (evbuf.kind != 0).sum(axis=0, dtype=jnp.int32).max().astype(jnp.int64)
-
-
-def ring_record(ring: TelemetryRing, m0, m1, evbuf,
+def ring_record(ring: TelemetryRing, m0, m1, ev_fill,
                 telem_reduce=None) -> TelemetryRing:
     """Write one per-window row (traced; called at the end of window_step).
 
     ``m0``/``m1`` are the Metrics before/after the window; counter columns
-    store ``m1 - m0``. ``telem_reduce(counters, fill) -> (counters, fill)``
-    globalizes the row under sharding (psum the deltas, max the fill);
-    identity on a single device. ``x2x_max_fill`` is already replicated by
-    the exchange's psum trick, so it bypasses the reduce."""
+    store ``m1 - m0``. ``ev_fill`` is the window-end event-slot fill the
+    engine already computed for the ``ev_max_fill`` gauge.
+    ``telem_reduce(counters, gauges) -> (counters, gauges)`` globalizes the
+    row under sharding (psum the counter deltas, elementwise-max the gauge
+    vector); identity on a single device. ``x2x_max_fill`` is already
+    replicated by the exchange's psum trick, so it bypasses the reduce."""
     w = ring.buf.shape[0]
     counters = jnp.stack(
         [getattr(m1, f) - getattr(m0, f) for f in RING_COUNTERS]
     )
-    fill = evbuf_fill(evbuf)
+    # RING_GAUGES order minus the trailing replicated x2x_max_fill.
+    gauges = jnp.stack(
+        [ev_fill, m1.ev_max_fill, m1.ob_max_fill, m1.compact_max_fill]
+    )
     if telem_reduce is not None:
-        counters, fill = telem_reduce(counters, fill)
+        counters, gauges = telem_reduce(counters, gauges)
     row = jnp.concatenate(
-        [counters, jnp.stack([fill, m1.x2x_max_fill])]
+        [counters, gauges, m1.x2x_max_fill[None]]
     ).astype(jnp.int64)
     # Slot = this window's global ordinal (the pre-increment counter).
     slot = (m0.windows % w).astype(jnp.int32)

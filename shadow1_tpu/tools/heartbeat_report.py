@@ -115,6 +115,17 @@ def summarize(recs: list[dict], out=None) -> dict:
                 d = rs[field]
                 print(f"  {field}: p50 {d['p50']}  p95 {d['p95']}  "
                       f"max {d['max']}", file=out)
+    # Capacity advisory (tools/captune.py): measured peaks vs the caps the
+    # records carry — the actionable line the cap-sizing debates need.
+    from shadow1_tpu.tools import captune
+
+    peaks, caps, overflow = captune.peaks_from_records(recs)
+    advice = captune.advise(peaks, caps, overflow)
+    if advice:
+        summary["captune"] = advice
+        print("== captune recommendation ==", file=out)
+        for line in captune.advise_lines(advice):
+            print(f"  {line}", file=out)
     if tr:
         last_per_host: dict[int, dict] = {}
         for r in tr:

@@ -1,0 +1,37 @@
+"""Occupancy-driven capacity tuning (the Eiffel/Laminar right-sizing loop).
+
+Every pop/push/clear in the round path is a full ``[cap, H]`` plane pass,
+so buffer caps multiply the cost of the whole engine (docs/PERF.md "cap
+economics"). This package closes the measure→size loop the telemetry ring
+opened:
+
+* ``ladder``  — the geometric cap ladder every tuned cap is quantized to
+  (bounds the number of distinct static shapes, hence jit recompiles);
+* ``resize``  — bit-exact host-side migration of the event-buffer/outbox
+  SoA planes to a new capacity (pad free slots to grow; compact-and-
+  truncate occupied slots to shrink — pop order is decided by the
+  (time, tb) keys, not slot index, so migration cannot reorder events);
+* ``autocap`` — the between-chunk controller behind ``--auto-caps``:
+  reads the run-max fill gauges at chunk boundaries (state is already on
+  host for the drain), grows before overflow, shrinks after sustained low
+  occupancy, and re-jits at the new static shape.
+
+``tools/captune.py`` is the offline half: it reads a finished run's ring
+JSONL / final-metrics record and prints recommended ``engine:`` settings.
+"""
+
+from shadow1_tpu.tune.autocap import CapController, CapPolicy
+from shadow1_tpu.tune.ladder import cap_ladder, next_step, quantize_cap, recommend_cap
+from shadow1_tpu.tune.resize import resize_evbuf, resize_outbox, resize_state
+
+__all__ = [
+    "CapController",
+    "CapPolicy",
+    "cap_ladder",
+    "next_step",
+    "quantize_cap",
+    "recommend_cap",
+    "resize_evbuf",
+    "resize_outbox",
+    "resize_state",
+]

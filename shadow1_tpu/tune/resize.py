@@ -1,0 +1,125 @@
+"""Bit-exact capacity migration of the engine's SoA planes.
+
+Runs on HOST (plain numpy) at chunk boundaries or checkpoint load — never
+inside the jitted window path — and re-shapes the ``[C, H]`` event-buffer
+planes / ``[P, H]`` outbox planes to a new static capacity:
+
+* **grow**: append free-slot sentinel rows (exactly the ``evbuf_init`` /
+  ``outbox_init`` fill values), occupied slots untouched;
+* **shrink**: stable-compact each host column's OCCUPIED slots to the front,
+  then truncate. Raises if any host holds more events than the new cap —
+  the controller only shrinks to ladder steps above the measured high-water,
+  so a refusal means the caller's policy is broken, not the data.
+
+Exactness argument: pop order is decided purely by the (time, tb) keys
+(core/events.py module docstring) and free-slot CONTENT is never read
+(every reader masks on ``kind != K_NONE`` / ``slot < cnt``), so any
+permutation of a column's occupied slots plus any free-slot padding is
+semantically the identity. Slot ASSIGNMENT of future pushes differs after a
+migration (first-free search, delivery rank), but that is an engine-internal
+layout detail with no observable effect — the same argument that makes
+``deliver_batch``'s layout engine-internal. The one caveat is overflow:
+WHICH events drop when a buffer fills is layout-defined, so runs are
+bit-exact across migrations only while the overflow counters stay 0 —
+the same contract cross-engine parity already lives under
+(docs/SEMANTICS.md "Bounds and overflow").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow1_tpu.consts import K_NONE
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+_I32_FREE = np.int32(np.iinfo(np.int32).max)  # events.I32_FREE
+
+
+def _tb_split_np(v) -> tuple[np.int32, np.int32]:
+    """numpy mirror of core/events.tb_split (order-preserving i64 → i32×2)."""
+    hi = np.int32(int(v) >> 32)
+    lo_bits = (int(v) & 0xFFFFFFFF) ^ 0x80000000  # sign-flip, as uint bits
+    lo = np.int32(lo_bits - (1 << 32) if lo_bits >= (1 << 31) else lo_bits)
+    return hi, lo
+
+
+def _pad_rows(x: np.ndarray, n: int, fill) -> np.ndarray:
+    """Append ``n`` slot rows (axis -2) filled with ``fill``."""
+    pad_shape = x.shape[:-2] + (n, x.shape[-1])
+    return np.concatenate([x, np.full(pad_shape, fill, x.dtype)], axis=-2)
+
+
+def resize_evbuf(buf, new_cap: int):
+    """EventBuf (numpy leaves) at cap C → the same queue contents at
+    ``new_cap``. Returns a new EventBuf; [H]-vector/scalar leaves
+    (self_ctr, epoch, n_elig, u32) are capacity-independent and carried
+    as-is."""
+    kind = np.asarray(buf.kind)
+    cap, _h = kind.shape
+    new_cap = int(new_cap)
+    if new_cap == cap:
+        return buf
+    planes = {f: np.asarray(getattr(buf, f))
+              for f in ("time_hi", "time_lo", "t32", "tb_hi", "tb_lo",
+                        "kind", "p")}
+    if new_cap < cap:
+        occupied = planes["kind"] != K_NONE
+        n_occ = occupied.sum(axis=0).max()
+        if n_occ > new_cap:
+            raise ValueError(
+                f"cannot shrink ev_cap {cap} -> {new_cap}: a host holds "
+                f"{int(n_occ)} events"
+            )
+        # Stable partition: occupied slots first, original slot order kept
+        # (argsort of the free flag is stable ⇒ ties keep slot order).
+        order = np.argsort(~occupied, axis=0, kind="stable")
+        for f, x in planes.items():
+            o = order if x.ndim == 2 else np.broadcast_to(order, x.shape)
+            planes[f] = np.take_along_axis(x, o, axis=-2)[..., :new_cap, :]
+    else:
+        thi, tlo = _tb_split_np(_I64_MAX)
+        n = new_cap - cap
+        planes["time_hi"] = _pad_rows(planes["time_hi"], n, thi)
+        planes["time_lo"] = _pad_rows(planes["time_lo"], n, tlo)
+        planes["t32"] = _pad_rows(planes["t32"], n, _I32_FREE)
+        for f in ("tb_hi", "tb_lo", "p"):
+            planes[f] = _pad_rows(planes[f], n, 0)
+        planes["kind"] = _pad_rows(planes["kind"], n, K_NONE)
+    return buf._replace(**planes)
+
+
+def resize_outbox(ob, new_cap: int):
+    """Outbox (numpy leaves) at cap P → ``new_cap``. Outbox entries are
+    contiguous in [0, cnt) per host (append-only within a window, cleared at
+    window end — chunk boundaries always see cnt == 0), so grow pads rows
+    and shrink truncates; slots ≥ cnt are never read, so stale content
+    beyond the truncation point is immaterial."""
+    dst = np.asarray(ob.dst)
+    cap, _h = dst.shape
+    new_cap = int(new_cap)
+    if new_cap == cap:
+        return ob
+    if new_cap < cap and int(np.asarray(ob.cnt).max()) > new_cap:
+        raise ValueError(
+            f"cannot shrink outbox_cap {cap} -> {new_cap}: a host has "
+            f"{int(np.asarray(ob.cnt).max())} pending sends"
+        )
+    planes = {}
+    for f in ("dst", "kind", "depart_hi", "depart_lo", "ctr", "p"):
+        x = np.asarray(getattr(ob, f))
+        planes[f] = (x[..., :new_cap, :] if new_cap < cap
+                     else _pad_rows(x, new_cap - cap, 0))
+    return ob._replace(**planes)
+
+
+def resize_state(st, ev_cap: int | None = None, outbox_cap: int | None = None):
+    """SimState → SimState with the event buffer / outbox migrated. Leaves
+    come back as numpy; callers re-place on device (engine.place_state).
+    Metrics, model state, cpu_busy and the telemetry ring are capacity-
+    independent and pass through untouched."""
+    repl = {}
+    if ev_cap is not None and int(ev_cap) != st.evbuf.kind.shape[-2]:
+        repl["evbuf"] = resize_evbuf(st.evbuf, ev_cap)
+    if outbox_cap is not None and int(outbox_cap) != st.outbox.dst.shape[-2]:
+        repl["outbox"] = resize_outbox(st.outbox, outbox_cap)
+    return st._replace(**repl) if repl else st
