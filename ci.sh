@@ -3,8 +3,11 @@
 #
 #   ./ci.sh            fast tier: full suite minus the slow mid-scale tier
 #   ./ci.sh all        everything, including 512–1024-host parity
-#   ./ci.sh smoke      config + events + ckpt/obs/telemetry + tune fast paths
-#                      (tgen-based tune tests stay in the fast/all tiers)
+#   ./ci.sh smoke      config + events + ckpt/obs/telemetry + tune + digest
+#                      fast paths (tgen-based tune tests stay in fast/all),
+#                      plus a tiny tpu-vs-cpu paritytrace bisect on the
+#                      rung-1 config: inject a window-8 corruption, assert
+#                      the flight recorder localizes it to exactly window 8
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -14,7 +17,22 @@ cd "$(dirname "$0")"
 
 tier="${1:-fast}"
 case "$tier" in
-  smoke) exec python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py -q -m "not slow" -k "not tgen" ;;
+  smoke)
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py -q -m "not slow" -k "not tgen"
+    echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
+    # CPU platform like the pytest tiers (conftest forces it there; the
+    # tool inherits the env) — the smoke must not depend on an accelerator.
+    out=$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.paritytrace \
+          configs/rung1_filexfer.yaml tpu cpu \
+          --windows 16 --chunk 8 --inject 8:rng --no-localize 2>/dev/null) && rc=0 || rc=$?
+    [ "$rc" -eq 3 ] || { echo "paritytrace: expected divergence exit 3, got $rc" >&2; exit 1; }
+    echo "$out" | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])["first_divergence"]
+assert d == {"window": 8, "subsystems": ["rng"]}, d
+print("paritytrace localized the injected corruption to", d)
+'
+    ;;
   fast)  exec python -m pytest tests/ -q -m "not slow" ;;
   all)   exec python -m pytest tests/ -q ;;
   *) echo "usage: $0 [smoke|fast|all]" >&2; exit 2 ;;

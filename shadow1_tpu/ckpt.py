@@ -31,7 +31,13 @@ import jax
 #      snapshot whose ev_cap/outbox_cap differs from the engine's restores
 #      via tune/resize.py instead of failing the shape check (--auto-caps
 #      runs checkpoint at whatever cap they had grown to)
-CKPT_FORMAT = 6
+#   7: determinism flight recorder — the telemetry ring row widens by the
+#      RING_DIGESTS state-digest columns (telemetry/registry.py), so any
+#      snapshot carrying a ring leaf changes shape. No digest STATE rides
+#      the snapshot beyond that: digest words are pure functions of the
+#      engine state, which is why a resumed run's digest stream continues
+#      bit-identically to the uninterrupted one with no extra bookkeeping.
+CKPT_FORMAT = 7
 
 
 def _flatten(st):

@@ -143,6 +143,16 @@ def main(argv=None) -> int:
                          "one per-window JSONL record to stderr per window "
                          "(drained at chunk boundaries; overrides "
                          "engine.metrics_ring from the config)")
+    ap.add_argument("--state-digest", choices=["on", "off"], default=None,
+                    metavar="on|off",
+                    help="determinism flight recorder (core/digest.py): "
+                         "compute per-window order-independent state digests "
+                         "(evbuf/outbox/tcp/nic/rng words) inside the window "
+                         "loop and carry them as telemetry-ring columns "
+                         "(batched engines; a ring is enabled automatically) "
+                         "or as per-window 'digest' JSONL records on stderr "
+                         "(cpu oracle). off (default) traces zero digest "
+                         "ops. Compare streams with tools/paritytrace.py")
     ap.add_argument("--log-level", default="message",
                     choices=["error", "warning", "message", "info", "debug"],
                     help="stderr log verbosity (reference --log-level analogue)")
@@ -157,6 +167,22 @@ def main(argv=None) -> int:
 
         params = dataclasses.replace(params, metrics_ring=args.metrics_ring)
     engine_kind = args.engine or scheduler
+    if args.state_digest is not None:
+        import dataclasses
+
+        params = dataclasses.replace(
+            params, state_digest=int(args.state_digest == "on"))
+    if (params.state_digest and params.metrics_ring <= 0
+            and args.metrics_ring is None and engine_kind != "cpu"):
+        # The digest words are ring columns on the batched engines; give the
+        # stream a transport when neither config nor flags did (depth = the
+        # heartbeat chunk keeps the drain gap-free). An EXPLICIT
+        # --metrics-ring 0 is honored and fails loudly in the engine's
+        # state_digest-needs-a-ring check instead.
+        import dataclasses
+
+        params = dataclasses.replace(
+            params, metrics_ring=args.heartbeat or 64)
     auto_caps = bool(args.auto_caps or params.auto_caps)
     if engine_kind == "cpu" and (args.save_state or args.resume
                                  or args.heartbeat or args.tracker
@@ -211,6 +237,11 @@ def main(argv=None) -> int:
         metrics = eng.run(n_windows=args.windows)
         summary = eng.summary()
         n_windows = args.windows if args.windows is not None else eng.n_windows
+        if params.state_digest:
+            # The oracle's per-window digest stream (REC_DIGEST rows) — the
+            # comparand for the batched engines' ring dg_* columns.
+            for rec in eng.digest_rows:
+                print(json.dumps(rec), file=sys.stderr)
     else:
         import jax
 
@@ -343,6 +374,12 @@ def main(argv=None) -> int:
         },
         "metrics": {k: int(v) for k, v in metrics.items()},
     }
+    # Drop accounting, grouped by reason (telemetry.registry.DROP_FIELDS) —
+    # the same structured block heartbeat records carry, with run totals.
+    from shadow1_tpu.telemetry.registry import DROP_FIELDS
+
+    drops = {f: int(metrics.get(f, 0)) for f in DROP_FIELDS}
+    out["drops"] = {"total": sum(drops.values()), **drops}
     if controller is not None:
         out["auto_caps"] = {
             "resizes": controller.resizes,

@@ -150,6 +150,15 @@ class EngineParams:
     # paces on outbox_space), so changing it mid-run changes the event
     # stream — see tune.autocap.CapPolicy.tune_outbox.
     auto_caps: int = 0
+    # Determinism flight recorder (core/digest.py): 1 = compute per-window
+    # order-independent state digests (one word per subsystem: evbuf,
+    # outbox, tcp, nic, rng counters) inside the jitted window loop and
+    # record them as telemetry-ring columns. Requires metrics_ring > 0 on
+    # the batched engines (the ring is where the stream lives); the CPU
+    # oracle mirrors the identical words at window boundaries. 0 (default)
+    # = off: zero digest ops traced anywhere — the ring columns exist but
+    # hold zeros. CLI --state-digest.
+    state_digest: int = 0
     # Pop-min result extraction: "sum" (masked-sum over the one-hot — the
     # round-4 default) or "gather" (index via min-over-iota, then
     # take_along_axis — the round-3 style on the round-4 layout). Bit-exact
@@ -181,6 +190,7 @@ class EngineParams:
         assert self.sockets_per_host <= 256, "sock ids are packed into 8 bits"
         assert self.pop_extract in ("sum", "gather"), self.pop_extract
         assert self.metrics_ring >= 0, self.metrics_ring
+        assert self.state_digest in (0, 1), self.state_digest
         assert self.auto_caps >= 0, self.auto_caps
         assert self.pop_impl in ("xla", "pallas"), self.pop_impl
         assert self.push_impl in ("xla", "pallas"), self.push_impl

@@ -447,6 +447,10 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     from shadow1_tpu.core.events import push_impl_ctx, rebase
 
     metrics_at_entry = st.metrics  # per-window delta baseline (ring)
+    # Determinism flight recorder (core/digest.py): traced only when the
+    # knob is on AND a ring exists to carry the words — state_digest=0
+    # (default) adds zero ops here and zero ops anywhere else.
+    digest_on = bool(ctx.params.state_digest) and st.telem is not None
     win_end = st.win_start + ctx.window
     if pre_window is not None:
         st = pre_window(st, ctx, win_end)
@@ -477,6 +481,12 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
             )
         else:
             st, cap_hit = run_rounds(st, ctx, handlers, win_end)
+    if digest_on:
+        # The outbox still holds this window's sends here — the delivery
+        # below routes and clears it, so its digest word is taken first.
+        from shadow1_tpu.core.digest import digest_outbox
+
+        dg_ob = digest_outbox(st.outbox, ctx.hosts)
     st = deliver_window(st, ctx, exchange)
     # Window-end event-slot occupancy: computed ONCE here (one [C, H] pass
     # per window, off the round path) and shared by the run-max gauge and
@@ -496,8 +506,17 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     if st.telem is not None:
         from shadow1_tpu.telemetry.ring import ring_record
 
+        digests = None
+        if digest_on:
+            # Everything but the outbox digests the post-delivery window-
+            # boundary state — exactly the pending/live sets the CPU oracle
+            # sees when its next event crosses this boundary.
+            from shadow1_tpu.core.digest import state_digests
+
+            digests = state_digests(st, ctx, dg_ob)
         st = st._replace(telem=ring_record(
-            st.telem, metrics_at_entry, st.metrics, ev_fill, telem_reduce
+            st.telem, metrics_at_entry, st.metrics, ev_fill, telem_reduce,
+            digests=digests,
         ))
     return st
 
@@ -563,6 +582,17 @@ def fidelity_ctx_kwargs(exp) -> dict:
     )
 
 
+def check_digest_params(params: EngineParams) -> None:
+    """state_digest needs a telemetry ring to carry the words on the
+    batched engines (the CPU oracle keeps its own rows and has no ring)."""
+    if params.state_digest and params.metrics_ring <= 0:
+        raise ValueError(
+            "state_digest=1 requires metrics_ring > 0 on the batched "
+            "engines — the per-window digest words are ring columns "
+            "(CLI --state-digest sets a ring automatically)"
+        )
+
+
 def _model_module(name: str):
     if name == "phold":
         from shadow1_tpu.core import phold
@@ -610,6 +640,7 @@ class Engine:
         exp.validate()
         self.exp = exp
         self.params = params or EngineParams()
+        check_digest_params(self.params)
         self.params = _resolve_kernel_impls(self.params, exp.n_hosts)
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))

@@ -98,6 +98,19 @@ class CpuEngine:
         self._next_boundary = self.window  # first window-end sample point
         # Per-kind pop occupancy fields (shared table — consts).
         self._pops_field = {k: f[0] for k, f in KIND_METRIC_FIELDS.items()}
+        # Determinism flight recorder (core/digest.py): the oracle mirrors
+        # the batched engines' per-window subsystem digests at window
+        # boundaries. The pending-event digest is maintained incrementally
+        # (add the element word on push, subtract it on pop — the sum is
+        # order-independent, so this equals the TPU's plane scan); outbox
+        # send words accumulate per window as sends happen; plane digests
+        # (tcp/nic/rng) are recomputed per boundary from live state. Rows
+        # land in ``digest_rows`` as JSONL-ready REC_DIGEST dicts.
+        self.digest_on = bool(self.params.state_digest)
+        self._ev_dg = 0
+        self._ev_word: dict[int, int] = {}  # gseq → element word
+        self._ob_dg: dict[int, int] = {}    # window → send-word sum
+        self.digest_rows: list[dict] = []
         self.model = self._make_model()
         self.model.start()
 
@@ -141,6 +154,16 @@ class CpuEngine:
             self.metrics["ob_max_fill"] = int(self._ob_used[src])
         ctr = int(self.pkt_ctr[src])
         self.pkt_ctr[src] += 1
+        if self.digest_on:
+            # The send occupies an outbox slot in the window of ``now`` —
+            # hashed before the loss draw, exactly like the TPU outbox
+            # (loss is drawn at routing time, after the slot was consumed).
+            from shadow1_tpu.core.digest import packet_word
+
+            w = now // self.window
+            self._ob_dg[w] = self._ob_dg.get(w, 0) + packet_word(
+                src, dst, depart, ctr, kind, p
+            )
         self.metrics["pkts_sent"] += 1
         vs = int(self.exp.host_vertex[src])
         vd = int(self.exp.host_vertex[dst])
@@ -176,6 +199,12 @@ class CpuEngine:
 
     def _push(self, time: int, tb: int, host: int, kind: int, p: tuple) -> None:
         self.pending[host] += 1
+        if self.digest_on:
+            from shadow1_tpu.core.digest import event_word
+
+            w = event_word(host, time, tb, kind, p)
+            self._ev_word[self._gseq] = w
+            self._ev_dg += w
         heapq.heappush(self.heap, (time, tb, self._gseq, host, kind, p))
         self._gseq += 1
 
@@ -183,14 +212,64 @@ class CpuEngine:
         """Window-end occupancy samples for every boundary ≤ ``upto``
         (exclusive of later ones): between two events the pending sets are
         static, so sampling when the next event's time crosses a boundary
-        sees exactly the state the batch engine gauges at window end."""
+        sees exactly the state the batch engine gauges at window end —
+        and, with digests on, exactly the state the batch engine digests
+        there (docs/SEMANTICS.md: the boundary pending/live sets are
+        engine-independent)."""
         if self._next_boundary > upto:
             return
         fill = int(self.pending.max()) if self.pending.size else 0
         if fill > self.metrics["ev_max_fill"]:
             self.metrics["ev_max_fill"] = fill
-        n_skipped = (upto - self._next_boundary) // self.window + 1
-        self._next_boundary += n_skipped * self.window
+        if not self.digest_on:
+            n_skipped = (upto - self._next_boundary) // self.window + 1
+            self._next_boundary += n_skipped * self.window
+            return
+        # One row per boundary window. The plane digests are static across
+        # a multi-boundary stretch (no event ran in between) — computed
+        # once; only the per-window outbox sums differ (0 for idle windows,
+        # matching the TPU's empty-outbox digest).
+        from shadow1_tpu.telemetry.registry import REC_DIGEST
+
+        dg_tcp, dg_nic, dg_rng = self._digest_planes()
+        while self._next_boundary <= upto:
+            w = self._next_boundary // self.window - 1
+            self.digest_rows.append({
+                "type": REC_DIGEST,
+                "window": w,
+                "dg_evbuf": self._ev_dg,
+                "dg_outbox": self._ob_dg.pop(w, 0),
+                "dg_tcp": dg_tcp,
+                "dg_nic": dg_nic,
+                "dg_rng": dg_rng,
+            })
+            self._next_boundary += self.window
+
+    def _digest_planes(self) -> tuple[int, int, int]:
+        """(dg_tcp, dg_nic, dg_rng) of the CURRENT state — the oracle twins
+        of core/digest.py's plane digests, same element words, same field
+        order."""
+        from shadow1_tpu.core import digest as D
+
+        model = self.model
+        dg_tcp = dg_nic = 0
+        extras: list = []
+        if hasattr(model, "socks"):  # net model: tcp + nic planes
+            from shadow1_tpu.consts import TCP_FREE
+
+            for h, socks in enumerate(model.socks):
+                for s, k in enumerate(socks):
+                    if k.st != TCP_FREE:
+                        dg_tcp += D.sock_word(h, s, k)
+            dg_nic = D.digest_nic_np(model.tx_free, model.rx_free,
+                                     model.tx_bytes, model.rx_bytes,
+                                     model.aqm_ctr)
+        elif hasattr(model, "hops"):  # phold: draw counters are model state
+            extras = [model.hops, model.ctr]
+        dg_rng = D.digest_rng_np(
+            [self.self_ctr, self.pkt_ctr, self.cpu_busy] + extras
+        )
+        return dg_tcp, dg_nic, dg_rng
 
     # -- main loop ---------------------------------------------------------
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
@@ -200,6 +279,8 @@ class CpuEngine:
             self._sample_fill(int(self.heap[0][0]))
             time, tb, _g, host, kind, p = heapq.heappop(self.heap)
             self.pending[host] -= 1
+            if self.digest_on:
+                self._ev_dg -= self._ev_word.pop(_g)
             # churn: a stopped host discards its events (core run_round rule)
             if self.has_stop and time >= self.stop_time[host]:
                 self.metrics["down_events"] += 1
@@ -216,9 +297,7 @@ class CpuEngine:
             if self.has_cpu:
                 eff = max(time, int(self.cpu_busy[host]))
                 if eff >= (time // self.window + 1) * self.window:
-                    self.pending[host] += 1
-                    heapq.heappush(self.heap, (eff, tb, self._gseq, host, kind, p))
-                    self._gseq += 1
+                    self._push(eff, tb, host, kind, p)
                     continue
                 self.cpu_busy[host] = eff + int(self.cpu_cost[host])
                 time = eff

@@ -100,6 +100,15 @@ class Heartbeat:
             if d_windows else None,
             "delta": delta,
         }
+        # Drop accounting: the nine ways an event/packet can be discarded,
+        # grouped under one structured block (with chunk deltas) instead of
+        # scattered through ``delta`` — the shape heartbeat_report's
+        # drop-reason table and alerting consume. Always present: an
+        # all-zero block is the explicit "nothing dropped" signal.
+        from shadow1_tpu.telemetry.registry import DROP_FIELDS
+
+        drops = {f: delta.pop(f, 0) for f in DROP_FIELDS}
+        rec["drops"] = {"total": sum(drops.values()), **drops}
         # Capacity occupancy: run-max fill gauges against their caps — the
         # data the cap controller and tools/captune.py size caps from.
         # High-water marks, not rates: they leave ``delta`` and ride a

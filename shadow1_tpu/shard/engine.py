@@ -95,6 +95,9 @@ class ShardedEngine:
         exp.validate()
         self.exp = exp
         self.params = params or EngineParams()
+        from shadow1_tpu.core.engine import check_digest_params
+
+        check_digest_params(self.params)
         devices = list(devices if devices is not None else jax.devices())
         self.n_dev = len(devices)
         if exp.n_hosts % self.n_dev:
@@ -345,7 +348,10 @@ class ShardedEngine:
             def telem_reduce(counters, gauges):
                 # Globalize one ring row: counter deltas are additive across
                 # shards (psum); the occupancy gauge vector needs an
-                # elementwise max.
+                # elementwise max. The state-digest words (appended to the
+                # counter vector by ring_record) are per-shard partial sums
+                # of globally-host-keyed element hashes, so the same psum
+                # yields the exact single-device digest on every shard.
                 return jax.lax.psum(counters, axis), pmax_(gauges)
 
             init_metrics = st.metrics

@@ -25,9 +25,12 @@ from shadow1_tpu.telemetry.profiler import (  # noqa: F401
     maybe_span,
 )
 from shadow1_tpu.telemetry.registry import (  # noqa: F401
+    DROP_FIELDS,
+    DROP_SPECS,
     METRIC_SPECS,
     RECORD_TYPES,
     RING_COUNTERS,
+    RING_DIGESTS,
     RING_FIELDS,
     RING_GAUGES,
     ExpositionServer,

@@ -70,11 +70,33 @@ METRIC_SPECS: dict[str, tuple[str, str]] = {
 }
 
 # JSONL record types every consumer recognises (docs/OBSERVABILITY.md).
+# ``digest`` is the CPU oracle's per-window state-digest row (the batched
+# engines carry the same words as ring columns instead).
 REC_HEARTBEAT = "heartbeat"
 REC_TRACKER = "tracker"
 REC_RING = "ring"
 REC_RING_GAP = "ring_gap"
-RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP)
+REC_DIGEST = "digest"
+RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
+                REC_DIGEST)
+
+# The drop/overflow counter group: every way a modeled event or packet can
+# be discarded, with the human-readable reason. Heartbeat records and the
+# CLI's final JSON group these under one structured ``drops`` block (and
+# tools/heartbeat_report.py prints them as a drop-reason table) instead of
+# nine flat counters scattered through ``delta``.
+DROP_SPECS: dict[str, str] = {
+    "ev_overflow": "event buffer full",
+    "ob_overflow": "outbox full",
+    "x2x_overflow": "all_to_all bucket full (sharded)",
+    "nic_tx_drops": "NIC uplink queue full",
+    "nic_rx_drops": "NIC downlink queue full",
+    "nic_aqm_drops": "RED early drop (uplink)",
+    "tcp_ooo_drops": "out-of-order segment (GBN receiver)",
+    "down_pkts": "destination host stopped",
+    "pkts_lost": "path loss draw",
+}
+DROP_FIELDS = tuple(DROP_SPECS)
 
 # ---------------------------------------------------------------------------
 # On-device telemetry ring schema (consumed by telemetry/ring.py, which owns
@@ -93,7 +115,19 @@ RING_GAUGES = (
     "compact_max_fill", # running high-water compaction-bucket demand
     "x2x_max_fill",     # running high-water all_to_all bucket demand
 )
-RING_FIELDS = RING_COUNTERS + RING_GAUGES
+# Determinism flight recorder (core/digest.py, EngineParams.state_digest):
+# one order-independent state-digest word per subsystem per window. All
+# zeros when state_digest is off. Sum-combined (psum'd under sharding),
+# NOT deltas and NOT gauges — compare them across runs, never aggregate.
+RING_DIGESTS = (
+    "dg_evbuf",   # occupied event slots keyed by (host, time, tb, kind, p)
+    "dg_outbox",  # this window's buffered sends (before the window-end clear)
+    "dg_tcp",     # live sockets: every tcp-plane field + message-boundary FIFO
+    "dg_nic",     # per-host NIC clocks and byte/AQM counters
+    "dg_rng",     # per-host deterministic counters (self_ctr/pkt_ctr/cpu_busy
+                  # + model draw counters)
+)
+RING_FIELDS = RING_COUNTERS + RING_GAUGES + RING_DIGESTS
 
 
 def counter_names() -> tuple[str, ...]:

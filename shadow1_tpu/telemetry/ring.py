@@ -62,28 +62,39 @@ def ring_init(n_windows: int) -> TelemetryRing | None:
 
 
 def ring_record(ring: TelemetryRing, m0, m1, ev_fill,
-                telem_reduce=None) -> TelemetryRing:
+                telem_reduce=None, digests=None) -> TelemetryRing:
     """Write one per-window row (traced; called at the end of window_step).
 
     ``m0``/``m1`` are the Metrics before/after the window; counter columns
     store ``m1 - m0``. ``ev_fill`` is the window-end event-slot fill the
-    engine already computed for the ``ev_max_fill`` gauge.
+    engine already computed for the ``ev_max_fill`` gauge. ``digests`` is
+    the per-window state-digest vector (i64 [len(RING_DIGESTS)],
+    core/digest.state_digests) or None — the digest columns then hold 0.
     ``telem_reduce(counters, gauges) -> (counters, gauges)`` globalizes the
     row under sharding (psum the counter deltas, elementwise-max the gauge
-    vector); identity on a single device. ``x2x_max_fill`` is already
-    replicated by the exchange's psum trick, so it bypasses the reduce."""
+    vector); identity on a single device. The digest words are per-shard
+    PARTIAL SUMS, so they ride the psum'd counter vector and come out as
+    the exact single-device digests. ``x2x_max_fill`` is already replicated
+    by the exchange's psum trick, so it bypasses the reduce."""
+    from shadow1_tpu.telemetry.registry import RING_DIGESTS
+
     w = ring.buf.shape[0]
     counters = jnp.stack(
         [getattr(m1, f) - getattr(m0, f) for f in RING_COUNTERS]
     )
+    if digests is None:
+        digests = jnp.zeros(len(RING_DIGESTS), jnp.int64)
+    n_ctr = counters.shape[0]
+    counters = jnp.concatenate([counters, digests])
     # RING_GAUGES order minus the trailing replicated x2x_max_fill.
     gauges = jnp.stack(
         [ev_fill, m1.ev_max_fill, m1.ob_max_fill, m1.compact_max_fill]
     )
     if telem_reduce is not None:
         counters, gauges = telem_reduce(counters, gauges)
+    counters, digests = counters[:n_ctr], counters[n_ctr:]
     row = jnp.concatenate(
-        [counters, gauges, m1.x2x_max_fill[None]]
+        [counters, gauges, m1.x2x_max_fill[None], digests]
     ).astype(jnp.int64)
     # Slot = this window's global ordinal (the pre-increment counter).
     slot = (m0.windows % w).astype(jnp.int32)

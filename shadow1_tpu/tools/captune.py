@@ -107,6 +107,10 @@ def peaks_from_records(recs: list[dict]) -> tuple[dict, dict, dict]:
             if isinstance(flat.get(knob), (int, float)) and flat[knob]:
                 caps[knob] = int(flat[knob])
         delta = r.get("delta") if isinstance(r.get("delta"), dict) else {}
+        if isinstance(r.get("drops"), dict):
+            # Heartbeats group the drop counters under a structured block
+            # (telemetry.registry.DROP_FIELDS) — same chunk deltas.
+            delta = {**delta, **r["drops"]}
         for ctr, knob in _CTRS:
             if r.get("type") == "ring" and isinstance(r.get(ctr), (int, float)):
                 ring_sum[knob] += int(r[ctr])

@@ -20,11 +20,14 @@ import math
 import sys
 
 from shadow1_tpu.telemetry.registry import (
+    DROP_SPECS,
     REC_HEARTBEAT,
     REC_RING,
     REC_RING_GAP,
     REC_TRACKER,
+    RING_COUNTERS,
     RING_FIELDS,
+    RING_GAUGES,
 )
 
 
@@ -57,7 +60,9 @@ def ring_summary(rings: list[dict]) -> dict:
     This is the table the rung-cap sizing debates need (docs/R6_NOTES.md):
     the chunk-averaged heartbeat hides the spikes; the ring records them."""
     out: dict = {"windows": len(rings)}
-    for field in RING_FIELDS:
+    # Digest columns (RING_DIGESTS) are identity words, not magnitudes —
+    # percentiles over them are noise, so only counters/gauges rank here.
+    for field in RING_COUNTERS + RING_GAUGES:
         series = [r[field] for r in rings if field in r]
         if not series:
             continue
@@ -100,6 +105,27 @@ def summarize(recs: list[dict], out=None) -> dict:
         print("== run summary ==", file=out)
         for k, v in summary.items():
             print(f"  {k}: {v}", file=out)
+    if hb:
+        # Drop-reason table: the heartbeat ``drops`` blocks summed over the
+        # run, one labeled row per reason (telemetry.registry.DROP_SPECS).
+        # Pre-drops-block logs fall back to the flat delta counters.
+        drop_totals = {f: 0 for f in DROP_SPECS}
+        for r in hb:
+            src = r.get("drops") if isinstance(r.get("drops"), dict) else \
+                r.get("delta", {})
+            for f in DROP_SPECS:
+                v = src.get(f)
+                if isinstance(v, (int, float)):
+                    drop_totals[f] += int(v)
+        total_drops = sum(drop_totals.values())
+        summary["drops"] = {"total": total_drops, **drop_totals}
+        print("== drops by reason ==", file=out)
+        if total_drops == 0:
+            print("  none (clean run: every modeled event/packet survived "
+                  "its bounds)", file=out)
+        for f, reason in DROP_SPECS.items():
+            if drop_totals[f]:
+                print(f"  {f}: {drop_totals[f]}  ({reason})", file=out)
     if rings:
         rs = ring_summary(rings)
         summary["ring"] = rs

@@ -73,6 +73,14 @@ def main() -> int:
                          "pop_impl=push_impl='pallas' (core/popk.py); the "
                          "primitive-level fused probes are pop_f/push_f/"
                          "cycle_f/obox_f")
+    ap.add_argument("--metrics-ring", type=int, default=0,
+                    help="phold_win probe: run with a W-deep telemetry "
+                         "ring (the ring-write cost per window)")
+    ap.add_argument("--state-digest", action="store_true",
+                    help="phold_win probe: run with the determinism flight "
+                         "recorder on (implies a ring; the acceptance "
+                         "budget is ≤5%% ms/round vs the plain ring — "
+                         "docs/PERF.md)")
     args = ap.parse_args()
 
     import shadow1_tpu  # noqa: F401
@@ -327,8 +335,11 @@ def main() -> int:
                            "init_events": 4},
             )
             impl = "pallas" if args.pallas else "xla"
+            ring = args.metrics_ring or (256 if args.state_digest else 0)
             eng = Engine(exp, EngineParams(ev_cap=C, pop_impl=impl,
-                                           push_impl=impl))
+                                           push_impl=impl,
+                                           metrics_ring=ring,
+                                           state_digest=int(args.state_digest)))
             st0 = eng.run(eng.init_state(), n_windows=10)  # warm state
             jax.block_until_ready(st0)
             m0 = Engine.metrics_dict(st0)

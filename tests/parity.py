@@ -1,0 +1,83 @@
+"""Shared end-of-run parity harness for the oracle-vs-batched test files.
+
+Every parity test used to copy-paste the same block: run both engines,
+assert the overflow guards, compare the semantic counters, compare summary
+vectors. This module is the single implementation — and on a mismatch it
+prints the ``tools/paritytrace.py`` invocation that would localize the
+divergence to an exact (window, subsystem) instead of leaving a bare
+end-of-run key mismatch (the determinism flight recorder,
+docs/SEMANTICS.md §"State digest").
+
+Not a test file itself (no ``test_`` prefix): pytest collects nothing here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow1_tpu.consts import EngineParams
+
+# Counters that must be bit-identical between the CPU oracle and the
+# batched engines (per-kind pops included: they guard the rx fast-path
+# split staying symmetric between engines).
+PARITY_KEYS = [
+    "events", "pkts_sent", "pkts_delivered", "pkts_lost",
+    "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
+    "pops_pkt", "pops_deliver", "pops_timer", "pops_txr", "pops_app",
+]
+
+_HINT = (
+    "\nlocalize the first divergent (window, subsystem) with the "
+    "determinism flight recorder:\n"
+    "    python -m shadow1_tpu.tools.paritytrace <experiment.yaml> "
+    "{a} {b}\n"
+    "(write the in-test experiment as a YAML config, or call "
+    "shadow1_tpu.tools.paritytrace.make_side/bisect directly on the "
+    "CompiledExperiment)"
+)
+
+
+def run_both(exp, params: EngineParams | None = None):
+    """Run ``exp`` on the CPU oracle and the single-device batched engine.
+
+    Returns (cpu_metrics, cpu_summary, tpu_metrics, tpu_summary)."""
+    from shadow1_tpu.core.engine import Engine
+    from shadow1_tpu.cpu_engine import CpuEngine
+
+    params = params or EngineParams()
+    cpu = CpuEngine(exp, params)
+    cm = cpu.run()
+    cs = cpu.summary()
+    eng = Engine(exp, params)
+    st = eng.run()
+    tm = Engine.metrics_dict(st)
+    ts = eng.model_summary(st)
+    return cm, cs, tm, ts
+
+
+def assert_parity(cm, cs, tm, ts, keys=("rx_bytes", "flows_done", "done_time"),
+                  metric_keys=PARITY_KEYS, sides=("tpu", "cpu")):
+    """The canonical oracle-vs-batched parity gate.
+
+    ``cm``/``tm`` are metric dicts, ``cs``/``ts`` model summaries; ``keys``
+    are the summary vectors to compare elementwise. The overflow guards run
+    first: parity is only defined for overflow-free runs (which packets
+    drop on overflow is layout-defined — docs/SEMANTICS.md)."""
+    hint = _HINT.format(a=sides[0], b=sides[1])
+    assert tm["ev_overflow"] == 0 and tm["ob_overflow"] == 0, (
+        f"overflow run: parity undefined (ev={tm['ev_overflow']}, "
+        f"ob={tm['ob_overflow']}) — raise the caps" + hint
+    )
+    assert tm["round_cap_hits"] == 0, (
+        "round cap hit: windows truncated — raise max_rounds" + hint
+    )
+    for k in metric_keys:
+        assert tm[k] == cm[k], (
+            f"counter {k!r} diverged: {sides[0]}={tm[k]} {sides[1]}={cm[k]}"
+            + hint
+        )
+    for k in keys:
+        np.testing.assert_array_equal(
+            np.asarray(ts[k]), np.asarray(cs[k]),
+            err_msg=f"summary {k!r} diverged" + hint,
+        )

@@ -123,10 +123,18 @@ def _run_detached_no_kill(src: str, timeout_s: float, env, cwd,
         return open(out_p).read(), open(err_p).read(), proc.returncode
 
 
+# One probe per test FILE, not per test: a hung tunnel eats the full probe
+# deadline, and paying it once already answers "is an accelerator alive"
+# for every test here (the children re-verify via their BACKEND_UP
+# sentinel anyway).
+_probe_result: tuple | None = None
+
+
 def _run_on_accelerator(child_src: str, timeout_s: int) -> dict:
     """Run ``child_src`` on the default (accelerator) platform; skip when no
     live accelerator exists, FAIL when the backend came up and the engine
     then broke on it (the regression these tests exist to catch)."""
+    global _probe_result
     # Undo conftest's CPU-forcing env mutations for the child so it boots
     # the default accelerator platform. (Probing via shadow1_tpu.platform
     # would inherit the conftest env and could mis-report cpu on machines
@@ -149,10 +157,16 @@ def _run_on_accelerator(child_src: str, timeout_s: int) -> dict:
     # this probe's own timeout-kill took the device down). On deadline the
     # child is left to finish detached and the test skips.
     probe_src = "import jax; print(jax.default_backend(), len(jax.devices()))"
-    stdout, stderr, rc = _run_detached_no_kill(
-        probe_src, 150, env, cwd,
-        skip_msg="accelerator backend init exceeded 150s probe deadline",
-    )
+    if _probe_result is None:
+        try:
+            _probe_result = _run_detached_no_kill(
+                probe_src, 150, env, cwd,
+                skip_msg="accelerator backend init exceeded 150s probe deadline",
+            )
+        except BaseException:  # incl. the deadline Skip — cache it, re-raise
+            _probe_result = ("", "probe deadline exceeded (cached)", 1)
+            raise
+    stdout, stderr, rc = _probe_result
     if rc != 0 or stdout.split()[:1] in ([], ["cpu"]):
         pytest.skip(f"no live accelerator backend: {stdout} {stderr[-300:]}")
     # Same no-kill rule for the real child: on deadline it is left to finish

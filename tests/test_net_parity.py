@@ -6,41 +6,11 @@ byte counts, same retransmit counters, same completion times.
 """
 
 import numpy as np
-import pytest
 
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
-from shadow1_tpu.core.engine import Engine
-from shadow1_tpu.cpu_engine import CpuEngine
-
-PARITY_KEYS = [
-    "events", "pkts_sent", "pkts_delivered", "pkts_lost",
-    "ev_overflow", "ob_overflow", "tcp_fast_rtx", "tcp_rto", "tcp_ooo_drops",
-    # per-kind pop occupancy: parity-exact like events (guards the rx
-    # fast-path split staying symmetric between engines)
-    "pops_pkt", "pops_deliver", "pops_timer", "pops_txr", "pops_app",
-]
-
-
-def run_both(exp, params=None):
-    params = params or EngineParams()
-    cpu = CpuEngine(exp, params)
-    cm = cpu.run()
-    cs = cpu.summary()
-    eng = Engine(exp, params)
-    st = eng.run()
-    tm = Engine.metrics_dict(st)
-    ts = eng.model_summary(st)
-    return cm, cs, tm, ts
-
-
-def assert_parity(cm, cs, tm, ts, keys=("rx_bytes", "flows_done", "done_time")):
-    assert tm["ev_overflow"] == 0 and tm["ob_overflow"] == 0
-    assert tm["round_cap_hits"] == 0
-    for k in PARITY_KEYS:
-        assert tm[k] == cm[k], (k, tm[k], cm[k])
-    for k in keys:
-        np.testing.assert_array_equal(np.asarray(ts[k]), np.asarray(cs[k]), err_msg=k)
+from tests.parity import PARITY_KEYS, assert_parity, run_both  # noqa: F401
+# (re-exported: older satellite tests imported the harness from here)
 
 
 def filexfer_exp(n_hosts=2, seed=11, loss=0.0, flow=100_000, end=20 * SEC, bw=10**7):

@@ -13,7 +13,7 @@ import pytest
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
 from shadow1_tpu.core.engine import Engine
-from shadow1_tpu.cpu_engine import CpuEngine
+from tests.parity import assert_parity, run_both
 
 
 def make_exp(n_hosts=16, seed=7, loss=0.0, end=1 * SEC, mean=20 * MS):
@@ -31,25 +31,11 @@ def make_exp(n_hosts=16, seed=7, loss=0.0, end=1 * SEC, mean=20 * MS):
 @pytest.mark.parametrize("loss", [0.0, 0.3])
 def test_phold_parity(loss):
     exp = make_exp(loss=loss)
-    params = EngineParams(ev_cap=64, outbox_cap=64)
-
-    cpu = CpuEngine(exp, params)
-    cpu_metrics = cpu.run()
-    cpu_sum = cpu.summary()
-
-    eng = Engine(exp, params)
-    st = eng.run()
-    tpu_metrics = Engine.metrics_dict(st)
-    tpu_sum = eng.model_summary(st)
-
-    assert tpu_metrics["ev_overflow"] == 0 and cpu_metrics["ev_overflow"] == 0
-    assert tpu_metrics["ob_overflow"] == 0 and cpu_metrics["ob_overflow"] == 0
-    assert tpu_metrics["round_cap_hits"] == 0
-    for k in ["events", "pkts_sent", "pkts_delivered", "pkts_lost"]:
-        assert tpu_metrics[k] == cpu_metrics[k], k
-    np.testing.assert_array_equal(
-        np.asarray(tpu_sum["hops"]), np.asarray(cpu_sum["hops"])
-    )
+    cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=64, outbox_cap=64))
+    assert cm["ev_overflow"] == 0 and cm["ob_overflow"] == 0
+    assert_parity(cm, cs, tm, ts, keys=("hops",),
+                  metric_keys=("events", "pkts_sent", "pkts_delivered",
+                               "pkts_lost"))
 
 
 def test_phold_pallas_pop_parity():

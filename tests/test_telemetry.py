@@ -106,9 +106,13 @@ def test_ring_one_record_per_window_sums_to_heartbeat_deltas():
     # resolution (the acceptance identity).
     for i, h in enumerate(hbs):
         chunk = [r for r in rings if i * 25 <= r["window"] < (i + 1) * 25]
-        for field in ("events", "rounds", "pkts_sent", "pkts_delivered",
-                      "pkts_lost", "ev_overflow"):
+        for field in ("events", "rounds", "pkts_sent", "pkts_delivered"):
             assert sum(r[field] for r in chunk) == h["delta"][field], field
+        # Drop counters ride the structured ``drops`` block (same deltas).
+        for field in ("pkts_lost", "ev_overflow"):
+            assert sum(r[field] for r in chunk) == h["drops"][field], field
+        assert h["drops"]["total"] == sum(
+            v for k, v in h["drops"].items() if k != "total")
     # The gauge actually observes occupancy.
     assert max(r["evbuf_fill"] for r in rings) > 0
     assert int(st.metrics.events) == sum(r["events"] for r in rings)

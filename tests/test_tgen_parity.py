@@ -10,7 +10,7 @@ import numpy as np
 
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
-from tests.test_net_parity import assert_parity, run_both
+from tests.parity import assert_parity, run_both
 
 TGEN_KEYS = ("rx_bytes", "streams_served", "streams_done", "done_time")
 
