@@ -7,7 +7,10 @@
 #                      fast paths (tgen-based tune tests stay in fast/all),
 #                      plus a tiny tpu-vs-cpu paritytrace bisect on the
 #                      rung-1 config: inject a window-8 corruption, assert
-#                      the flight recorder localizes it to exactly window 8
+#                      the flight recorder localizes it to exactly window 8;
+#                      plus the fault-plane smokes: a shortened churn-
+#                      scenario cpu-vs-tpu digest parity run (churnprobe)
+#                      and corrupt-checkpoint rejection (integrity digest)
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -31,6 +34,52 @@ import json, sys
 d = json.loads(sys.stdin.read().strip().splitlines()[-1])["first_divergence"]
 assert d == {"window": 8, "subsystems": ["rng"]}, d
 print("paritytrace localized the injected corruption to", d)
+'
+    echo "== churn-scenario parity smoke (fault plane, cpu vs tpu) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.churnprobe \
+        configs/churn_filexfer.yaml --sides cpu,tpu --windows 40 --chunk 20 \
+        2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+assert d["digest_windows_compared"]["tpu"] == 40, d
+print("churnprobe: 40-window digest parity ok;",
+      "restarts:", d["counters"]["tpu"]["host_restarts"],
+      "down_pkts:", d["counters"]["tpu"]["down_pkts"])
+'
+    echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
+import tempfile, os
+import shadow1_tpu
+from shadow1_tpu.ckpt import (CorruptCheckpointError, load_state,
+                              save_state, verify_file)
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.core.engine import Engine
+eng = Engine(single_vertex_experiment(
+    n_hosts=8, seed=4, end_time=20 * MS, latency_ns=1 * MS, model="phold",
+    model_cfg={"mean_delay_ns": float(2 * MS)}), EngineParams())
+st = eng.run(n_windows=5)
+path = os.path.join(tempfile.mkdtemp(), "snap.npz")
+save_state(st, path)
+assert verify_file(path)[0]
+load_state(eng.init_state(), path)
+import numpy as np
+with np.load(path) as d:
+    arrs = {k: d[k].copy() for k in d.files}
+leaf = next(k for k in arrs if k.startswith("leaf_")
+            and arrs[k].size and arrs[k].dtype != np.bool_)
+arrs[leaf].reshape(-1).view(np.uint8)[0] ^= 0x20
+np.savez(path, **arrs)  # payload changed, stored integrity now stale
+ok, why = verify_file(path)
+assert not ok, "bit flip must not verify"
+try:
+    load_state(eng.init_state(), path)
+except (CorruptCheckpointError, ValueError):
+    pass
+else:
+    raise AssertionError("corrupt snapshot loaded silently")
+print("corrupt checkpoint rejected:", why)
 '
     ;;
   fast)  exec python -m pytest tests/ -q -m "not slow" ;;

@@ -37,7 +37,55 @@ import jax
 #      the snapshot beyond that: digest words are pure functions of the
 #      engine state, which is why a resumed run's digest stream continues
 #      bit-identically to the uninterrupted one with no extra bookkeeping.
-CKPT_FORMAT = 7
+#   8: fault plane — Metrics gains link_down_pkts / host_restarts, the ring
+#      row widens by the matching counter columns, and every snapshot now
+#      carries an ``integrity`` splitmix64 digest over all leaves:
+#      load_state rejects truncated or bit-flipped snapshots with
+#      CorruptCheckpointError instead of resuming from garbage, and the
+#      supervisor (cli._supervise) discards a corrupt checkpoint like a
+#      stale one rather than crash-looping on it.
+CKPT_FORMAT = 8
+
+
+class CorruptCheckpointError(ValueError):
+    """The snapshot file is damaged (truncated zip, undecodable member, or
+    integrity-digest mismatch) — as opposed to a well-formed snapshot of
+    the wrong config, which stays a plain ValueError."""
+
+
+_IM64 = (1 << 64) - 1
+_IK = 0x2545F4914F6CDD1D           # the digest fold multiplier (core/digest)
+_ISEED = 0xC6A4A7935BD1E995        # distinct seed: file integrity domain
+
+
+def _integrity_digest(leaves) -> int:
+    """Position-sensitive splitmix64 digest of the snapshot payload.
+
+    Per leaf: the raw bytes (u64-padded) are each mixed with their word
+    position and xor-reduced; leaf hashes then fold in order with the byte
+    length, so any single flipped bit, swapped word, or truncated tail
+    changes the digest. numpy-only — the supervisor verifies checkpoints
+    host-side without touching an accelerator."""
+    from shadow1_tpu.core.digest import _mix_int
+    from shadow1_tpu.rng import _mix_np
+
+    z = _ISEED
+    for i, a in enumerate(leaves):
+        a = np.ascontiguousarray(np.asarray(a))
+        b = a.tobytes()
+        pad = (-len(b)) % 8
+        u = np.frombuffer(b + b"\0" * pad, np.uint64)
+        if u.size:
+            with np.errstate(over="ignore"):
+                pos = np.arange(u.size, dtype=np.uint64)
+                w = _mix_np(u + _mix_np(pos * np.uint64(_IK)
+                                        + np.uint64(i + 1)))
+            h = int(np.bitwise_xor.reduce(w))
+        else:
+            h = 0
+        z = _mix_int((z * _IK + h) & _IM64)
+        z = (z * _IK + len(b)) & _IM64
+    return _mix_int(z)
 
 
 def _flatten(st):
@@ -56,6 +104,10 @@ def save_state(st, path: str) -> None:
     leaves, _ = _flatten(st)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     arrays["format"] = np.asarray([CKPT_FORMAT, len(leaves)], np.int64)
+    arrays["integrity"] = np.asarray(
+        [_integrity_digest(arrays[f"leaf_{i}"] for i in range(len(leaves)))],
+        np.uint64,
+    )
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
@@ -74,20 +126,44 @@ def load_state(template, path: str, migrate_caps: bool = True):
     restore into an engine built from the config's static caps. Every other
     shape/dtype difference still fails as a config mismatch."""
     tleaves, treedef = _flatten(template)
-    with np.load(path) as data:
-        fmt = data["format"] if "format" in data.files else np.asarray([1, -1])
-        if int(fmt[0]) != CKPT_FORMAT:
-            raise ValueError(
-                f"checkpoint {path} has format v{int(fmt[0])}, this build "
-                f"reads v{CKPT_FORMAT} — snapshot from an incompatible "
-                f"framework version; re-run from scratch"
-            )
-        if int(fmt[1]) != len(tleaves):
-            raise ValueError(
-                f"checkpoint {path} holds {int(fmt[1])} state leaves, engine "
-                f"expects {len(tleaves)} — engine config mismatch"
-            )
-        leaves = [data[f"leaf_{i}"] for i in range(len(tleaves))]
+    try:
+        with np.load(path) as data:
+            fmt = (data["format"] if "format" in data.files
+                   else np.asarray([1, -1]))
+            n_saved = int(fmt[1])
+            saved = [data[f"leaf_{i}"] for i in range(max(n_saved, 0))
+                     if f"leaf_{i}" in data.files]
+            stored = (int(data["integrity"][0])
+                      if "integrity" in data.files else None)
+    except Exception as e:  # truncated zip / undecodable member / bad header
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e}) — "
+            f"truncated or damaged snapshot; discard it and re-run"
+        ) from e
+    if int(fmt[0]) != CKPT_FORMAT:
+        raise ValueError(
+            f"checkpoint {path} has format v{int(fmt[0])}, this build "
+            f"reads v{CKPT_FORMAT} — snapshot from an incompatible "
+            f"framework version; re-run from scratch"
+        )
+    if stored is None or len(saved) != n_saved:
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is missing state members "
+            f"({len(saved)}/{n_saved} leaves, integrity "
+            f"{'present' if stored is not None else 'absent'}) — truncated "
+            f"snapshot; discard it and re-run"
+        )
+    if _integrity_digest(saved) != stored:
+        raise CorruptCheckpointError(
+            f"checkpoint {path} fails its integrity digest — the snapshot "
+            f"was bit-corrupted after writing; discard it and re-run"
+        )
+    if n_saved != len(tleaves):
+        raise ValueError(
+            f"checkpoint {path} holds {n_saved} state leaves, engine "
+            f"expects {len(tleaves)} — engine config mismatch"
+        )
+    leaves = saved
     if migrate_caps:
         # Structure (leaf count) already matched, so the saved leaves
         # unflatten into a SimState whose planes carry the SAVED caps;
@@ -121,6 +197,34 @@ def load_state(template, path: str, migrate_caps: bool = True):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def verify_file(path: str) -> tuple[bool, str | None]:
+    """Host-side snapshot health check: (ok, reason-if-not).
+
+    Reads the file with numpy only (no engine, no accelerator) and checks
+    the member set plus the integrity digest — the supervisor runs this
+    BEFORE spawning a child on a leftover checkpoint, so a bit-corrupted
+    snapshot is discarded like a stale one instead of crash-looping the
+    respawn budget away (cli._supervise)."""
+    try:
+        with np.load(path) as data:
+            if "format" not in data.files:
+                return False, "no format member"
+            n = int(data["format"][1])
+            if "integrity" not in data.files:
+                return False, "no integrity digest (pre-v8 or truncated)"
+            stored = int(data["integrity"][0])
+            leaves = []
+            for i in range(n):
+                if f"leaf_{i}" not in data.files:
+                    return False, f"missing leaf_{i} of {n}"
+                leaves.append(data[f"leaf_{i}"])
+    except Exception as e:
+        return False, f"unreadable ({type(e).__name__}: {e})"
+    if _integrity_digest(leaves) != stored:
+        return False, "integrity digest mismatch (bit corruption)"
+    return True, None
+
+
 def snapshot_caps(template, path: str) -> tuple[int, int] | None:
     """(ev_cap, outbox_cap) a snapshot was SAVED at, read off its leaf
     shapes without loading the full state. An ``--auto-caps`` run
@@ -141,14 +245,20 @@ def snapshot_caps(template, path: str) -> tuple[int, int] | None:
 
     i_ev = idx(template.evbuf.kind)
     i_ob = idx(template.outbox.dst)
-    with np.load(path) as data:
-        for i in (i_ev, i_ob):
-            if i is None or f"leaf_{i}" not in data.files:
-                return None
-        ev, ob = data[f"leaf_{i_ev}"].shape, data[f"leaf_{i_ob}"].shape
-        if len(ev) != 2 or len(ob) != 2:
-            return None
-        return int(ev[-2]), int(ob[-2])
+    try:
+        with np.load(path) as data:
+            for i in (i_ev, i_ob):
+                if i is None or f"leaf_{i}" not in data.files:
+                    return None
+            ev, ob = data[f"leaf_{i_ev}"].shape, data[f"leaf_{i_ob}"].shape
+    except Exception as e:  # truncated zip / undecodable member
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: {e}) — "
+            f"truncated or damaged snapshot; discard it and re-run"
+        ) from e
+    if len(ev) != 2 or len(ob) != 2:
+        return None
+    return int(ev[-2]), int(ob[-2])
 
 
 def run_chunked(engine, st=None, n_windows: int | None = None,

@@ -33,9 +33,24 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
     bench_ladder.py): run the CLI in a child process; when it dies with a
     checkpoint showing forward progress, respawn a fresh child that resumes
     from the snapshot — a wedged-runtime fault never survives into the next
-    attempt because the next attempt is a new process."""
+    attempt because the next attempt is a new process.
+
+    Failure handling beyond the bare respawn loop:
+
+    * **corrupt checkpoints** are verified host-side (ckpt.verify_file)
+      before every spawn and discarded like stale ones — the child restarts
+      from scratch instead of crash-looping on a bit-flipped snapshot;
+    * **exponential backoff**: the respawn delay doubles on consecutive
+      no-progress crashes and resets when a crash follows forward progress
+      (env SHADOW1_SUPERVISE_BACKOFF_S tunes the base; tests set 0);
+    * **failure classification**: two consecutive crashes at the same
+      ``win_start`` mean the fault is deterministic at that sim time — a
+      third identical attempt would burn the respawn budget for nothing,
+      so the supervisor aborts with a diagnosis instead.
+    """
     import os
     import subprocess
+    import time as _time
 
     sidecar = ckpt_path + ".progress"
     meta_path = ckpt_path + ".meta"
@@ -58,9 +73,26 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
                 os.remove(p)
     with open(meta_path, "w") as f:
         json.dump({"config_sha256": fp}, f)
+    backoff_base = float(os.environ.get("SHADOW1_SUPERVISE_BACKOFF_S", "1.0"))
     last_progress = -1
+    no_progress = 0  # consecutive crashes without forward progress
     rc = 1
     for attempt in range(MAX_RESPAWNS + 1):
+        if os.path.exists(ckpt_path):
+            from shadow1_tpu.ckpt import verify_file
+
+            ok, why = verify_file(ckpt_path)
+            if not ok:
+                # Same policy as a stale snapshot: restart from scratch.
+                # The progress baseline resets with it — the next child
+                # legitimately re-earns its first windows.
+                print(f"[supervise] discarding corrupt checkpoint "
+                      f"{ckpt_path} ({why}); restarting from scratch",
+                      file=sys.stderr, flush=True)
+                for p in (ckpt_path, sidecar):
+                    if os.path.exists(p):
+                        os.remove(p)
+                last_progress = -1
         cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
                "--supervised-child"]
         rc = subprocess.run(cmd).returncode  # stdio inherited: heartbeats flow
@@ -78,14 +110,35 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
                     progress = json.load(f).get("win_start", -1)
             except (OSError, ValueError):
                 progress = -1
-        if progress <= last_progress or attempt == MAX_RESPAWNS:
-            # Failure before the first checkpoint, or a whole process with
-            # no forward progress: a respawn would just repeat it.
+        if progress > last_progress:
+            no_progress = 0
+            last_progress = progress
+        else:
+            no_progress += 1
+            if no_progress >= 2:
+                print(
+                    f"[supervise] two consecutive crashes (rc={rc}) with no "
+                    f"forward progress at sim_ns={max(progress, 0)} — the "
+                    f"fault is deterministic at that point, further "
+                    f"respawns would repeat it. Diagnose with "
+                    f"`python -m shadow1_tpu.tools.faultprobe` (device/"
+                    f"kernel faults) or `python -m shadow1_tpu.tools."
+                    f"paritytrace {config_path} tpu cpu` (state "
+                    f"divergence).",
+                    file=sys.stderr, flush=True)
+                return rc
+        if attempt == MAX_RESPAWNS:
             return rc
-        last_progress = progress
+        # Base delay after a crash that made progress (no_progress == 0),
+        # doubled per consecutive no-progress crash — the classifier above
+        # bounds the exponent, not this formula.
+        delay = backoff_base * (2 ** no_progress)
         print(f"[supervise] child died rc={rc} at sim_ns={progress}; "
-              f"respawning ({attempt + 1}/{MAX_RESPAWNS})",
+              f"respawning ({attempt + 1}/{MAX_RESPAWNS}) "
+              f"after {delay:.1f}s backoff",
               file=sys.stderr, flush=True)
+        if delay > 0:
+            _time.sleep(delay)
     return rc
 
 
@@ -153,6 +206,14 @@ def main(argv=None) -> int:
                          "or as per-window 'digest' JSONL records on stderr "
                          "(cpu oracle). off (default) traces zero digest "
                          "ops. Compare streams with tools/paritytrace.py")
+    ap.add_argument("--faults", choices=["on", "off"], default="on",
+                    metavar="on|off",
+                    help="fault plane (config `faults:` section — host "
+                         "down/up cycles, link outage windows, timed loss "
+                         "ramps; docs/SEMANTICS.md §'Fault plane'). "
+                         "`off` runs the same experiment with the schedule "
+                         "stripped (the healthy-world A/B); the legacy "
+                         "per-group stop_time churn is unaffected")
     ap.add_argument("--log-level", default="message",
                     choices=["error", "warning", "message", "info", "debug"],
                     help="stderr log verbosity (reference --log-level analogue)")
@@ -162,6 +223,8 @@ def main(argv=None) -> int:
     from shadow1_tpu.config.experiment import load_experiment
 
     exp, params, scheduler = load_experiment(args.config)
+    if args.faults == "off":
+        exp.faults = None
     if args.metrics_ring is not None:
         import dataclasses
 
@@ -258,35 +321,56 @@ def main(argv=None) -> int:
         resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
                        else args.resume)
         if resume_path:
-            from shadow1_tpu.ckpt import load_state, snapshot_caps
+            from shadow1_tpu.ckpt import (
+                CorruptCheckpointError,
+                load_state,
+                snapshot_caps,
+            )
 
-            template = eng.init_state()
-            if auto_caps:
-                # An --auto-caps run checkpoints at whatever cap it had
-                # grown to; a host may hold more events than the config's
-                # static cap, so the respawned engine must START at the
-                # snapshot's caps (the controller re-shrinks later if the
-                # occupancy allows) — otherwise every respawn would die in
-                # the shrink-refuses-to-drop-events check.
-                snap = snapshot_caps(template, resume_path)
-                if snap and snap != (params.ev_cap, params.outbox_cap):
-                    import dataclasses
+            params0, eng0 = params, eng
+            try:
+                template = eng.init_state()
+                if auto_caps:
+                    # An --auto-caps run checkpoints at whatever cap it had
+                    # grown to; a host may hold more events than the
+                    # config's static cap, so the respawned engine must
+                    # START at the snapshot's caps (the controller
+                    # re-shrinks later if the occupancy allows) — otherwise
+                    # every respawn would die in the
+                    # shrink-refuses-to-drop-events check.
+                    snap = snapshot_caps(template, resume_path)
+                    if snap and snap != (params.ev_cap, params.outbox_cap):
+                        import dataclasses
 
-                    params = dataclasses.replace(
-                        params, ev_cap=snap[0], outbox_cap=snap[1])
-                    eng = Eng(exp, params)
-                    template = eng.init_state()
-            st = load_state(template, resume_path)
-            metrics0 = Eng.metrics_dict(st)
-            done = int(st.win_start) // exp.window
-            if args.windows is None:
-                # Complete the configured run: only the windows remaining
-                # after the checkpoint, not n_windows again on top of it.
-                args.windows = max(eng.n_windows - done, 0)
-            elif resume_path == args.ckpt:
-                # Supervised respawn: --windows is the TOTAL for the whole
-                # supervised run, not N more on top of the snapshot.
-                args.windows = max(args.windows - done, 0)
+                        params = dataclasses.replace(
+                            params, ev_cap=snap[0], outbox_cap=snap[1])
+                        eng = Eng(exp, params)
+                        template = eng.init_state()
+                st = load_state(template, resume_path)
+            except CorruptCheckpointError as e:
+                # Supervised child: a damaged snapshot must not crash-loop
+                # the respawn budget — fall back to a fresh start (the
+                # supervisor pre-verifies too; this covers corruption in
+                # between, at no extra hashing on the healthy path). An
+                # explicit --resume keeps failing loudly instead.
+                if resume_path != args.ckpt:
+                    raise
+                log.warning("discarding corrupt checkpoint",
+                            path=resume_path, reason=str(e))
+                st, params, eng = None, params0, eng0
+            else:
+                metrics0 = Eng.metrics_dict(st)
+                done = int(st.win_start) // exp.window
+                if args.windows is None:
+                    # Complete the configured run: only the windows
+                    # remaining after the checkpoint, not n_windows again
+                    # on top of it.
+                    args.windows = max(eng.n_windows - done, 0)
+                elif resume_path == args.ckpt:
+                    # Supervised respawn: --windows is the TOTAL for the
+                    # whole supervised run, not N more on top of the
+                    # snapshot.
+                    args.windows = max(args.windows - done, 0)
         import contextlib
 
         prof = (jax.profiler.trace(args.profile) if args.profile
@@ -380,6 +464,12 @@ def main(argv=None) -> int:
 
     drops = {f: int(metrics.get(f, 0)) for f in DROP_FIELDS}
     out["drops"] = {"total": sum(drops.values()), **drops}
+    # Fault plane run totals (schema mirrors the heartbeat ``faults`` block).
+    restarts = int(metrics.get("host_restarts", 0))
+    fault_drops = {k: drops[k] for k in
+                   ("down_events", "down_pkts", "link_down_pkts")}
+    if restarts or any(fault_drops.values()):
+        out["faults"] = {"host_restarts": restarts, **fault_drops}
     if controller is not None:
         out["auto_caps"] = {
             "resizes": controller.resizes,

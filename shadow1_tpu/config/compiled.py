@@ -53,6 +53,12 @@ class CompiledExperiment:
     aqm_min_bytes: np.ndarray | None = None     # i64 [H]
     aqm_max_bytes: np.ndarray | None = None     # i64 [H], 0 = AQM off
     aqm_pmax: np.ndarray | None = None          # f64 [H] drop prob at max
+    # Deterministic fault plane (fault/schedule.FaultSchedule or None):
+    # host down/up cycles, link outage windows, timed loss ramps — compiled
+    # to dense tables both engines share (docs/SEMANTICS.md §"Fault
+    # plane"). The legacy per-group stop_time above is the degenerate
+    # one-interval case and merges into the same tables.
+    faults: Any = None
     # Host-side name registry (config/dns.py); None for programmatic
     # experiments (ids only). Never enters device state.
     dns: Any = None
@@ -105,6 +111,8 @@ class CompiledExperiment:
         assert ((self.aqm_pmax[on] > 0) & (self.aqm_pmax[on] <= 1)).all(), (
             "RED needs 0 < aqm_pmax <= 1 where enabled"
         )
+        if self.faults is not None:
+            self.faults.validate(self.n_hosts, self.lat_vv.shape[0])
         assert self.end_time > 0
         assert int(self.window) < 2**31 - 1, (
             "conservative window must fit the i32 rebased pop keys "

@@ -31,6 +31,14 @@ Schema:
       defaults: {...}            # per-host params, broadcast to all hosts
       groups:                    # per-host params, per host group
         relay: {...}
+    faults:                      # deterministic fault plane (fault/schedule.py;
+      hosts:                     #   docs/SEMANTICS.md "Fault plane")
+        - {group: relay, down_at: 2 s, up_at: 3 s}   # churn cycles
+      links:
+        - {src_vertex: pop_a, dst_vertex: pop_b, down_at: 4 s, up_at: 5 s}
+      loss:
+        - {src_vertex: pop_a, dst_vertex: pop_b, from: 6 s, until: 7 s,
+           loss: 0.2}
 
 Per-host values may be scalars or lists of length == group count. Durations
 and bandwidths accept the unit strings above anywhere.
@@ -352,6 +360,11 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
             pname, dtype, default, parser, groups, defaults, group_cfg, h
         )
 
+    # -- faults ------------------------------------------------------------
+    from shadow1_tpu.fault.schedule import parse_faults
+
+    faults = parse_faults(doc.get("faults"), groups, names)
+
     if app == "bitcoin":
         _gen_bitcoin_cfg(model_cfg, h, seed)
     if app == "phold":
@@ -380,6 +393,7 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         aqm_min_bytes=aqm_min,
         aqm_max_bytes=aqm_max,
         aqm_pmax=aqm_pmax,
+        faults=faults,
         dns=Dns.from_groups(groups, host_vertex),
     )
     exp.validate()

@@ -106,6 +106,10 @@ class Metrics(NamedTuple):
     fires_timer: jnp.ndarray
     fires_txr: jnp.ndarray
     fires_app: jnp.ndarray
+    # Fault plane (shadow1_tpu/fault/): deterministic link outages and host
+    # restarts (docs/SEMANTICS.md §"Fault plane").
+    link_down_pkts: jnp.ndarray  # packets dropped: link outage window
+    host_restarts: jnp.ndarray   # host restart resets applied (churn up)
 
 
 def _metrics_init() -> Metrics:
@@ -156,7 +160,14 @@ class Ctx:
     # has_* flags are TRACE-TIME booleans so disabled features compile to
     # nothing.
     jitter_vv: jax.Array = None    # i64 [V, V]
-    stop_time: jax.Array = None    # i64 [H]
+    # Host churn intervals (fault/schedule.host_interval_tensors): host h is
+    # DOWN at time t iff any k has fault_down[k,h] <= t < fault_up[k,h].
+    # The legacy single stop_time compiles to one [stop, NO_STOP) interval.
+    fault_down: jax.Array = None   # i64 [K, H]
+    fault_up: jax.Array = None     # i64 [K, H] (window-quantized; NO_STOP)
+    link_fault: Any = None         # (src, dst, t0, t1) [L] tables or None
+    loss_ramp: Any = None          # (src, dst, t0, t1, thr) [R] or None
+    init_model: Any = None         # post-init model pytree (restart target)
     cpu_cost: jax.Array = None     # i64 [H] virtual CPU ns per event
     tx_qlen_ns: jax.Array = None   # i64 [H] uplink queue bound (ns of backlog)
     rx_qlen_ns: jax.Array = None   # i64 [H]
@@ -164,7 +175,10 @@ class Ctx:
     aqm_span_ns: jax.Array = None  # i64 [H] RED max − min (≥1 where enabled)
     aqm_pmax_thr: jax.Array = None # u64 [H] Bernoulli threshold at pmax
     has_jitter: bool = False
-    has_stop: bool = False
+    has_stop: bool = False         # any host down interval exists
+    has_restart: bool = False      # any finite up time (restart resets)
+    has_link_fault: bool = False
+    has_loss_ramp: bool = False
     has_cpu: bool = False
     has_tx_qlen: bool = False
     has_rx_qlen: bool = False
@@ -237,9 +251,11 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     Two fidelity gates apply between pop and dispatch (both compile to
     nothing when the knobs are off):
 
-    * **churn** (config host stop times): an event whose time is ≥ its
-      host's stop_time is discarded (counted in ``down_events``) — the
-      batch analogue of the reference halting a host's processes;
+    * **churn** (fault plane / config host stop times): an event whose
+      time falls inside a down interval of its host is discarded (counted
+      in ``down_events``) — the batch analogue of the reference halting a
+      host's processes; events timed after a restart execute against the
+      reset state (docs/SEMANTICS.md §"Fault plane");
     * **virtual CPU** (src/main/host/cpu.c): execution time is
       ``eff = max(time, cpu_busy[h])``; if eff crosses the window boundary
       the event re-queues at (eff, original tb) unexecuted, else it
@@ -262,7 +278,9 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     m = st.metrics
     n_down = jnp.zeros((), jnp.int64)
     if ctx.has_stop:
-        supp = ev.mask & (ev.time >= ctx.stop_time)
+        from shadow1_tpu.fault.plane import hosts_down_at
+
+        supp = ev.mask & hosts_down_at(ctx.fault_down, ctx.fault_up, ev.time)
         n_down = supp.sum(dtype=jnp.int64)
         ev = ev._replace(mask=ev.mask & ~supp,
                          kind=jnp.where(supp, 0, ev.kind))
@@ -308,12 +326,18 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     return st
 
 
-def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.ndarray]:
-    """Route this block's outbox: latency gather + loss draws (src side).
+def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray,
+                                                jnp.ndarray, jnp.ndarray]:
+    """Route this block's outbox: latency gather + fault gates + loss draws.
 
     The tensor analogue of the reference's topology path lookup at send time
-    (src/main/routing/topology.c getLatency/getReliability, SURVEY §3.3).
-    Returns (flat_packets, n_sent, n_lost)."""
+    (src/main/routing/topology.c getLatency/getReliability, SURVEY §3.3),
+    plus the fault plane's source-side gates (docs/SEMANTICS.md §"Fault
+    plane"), in canonical order: a packet departing inside a link-outage
+    window is dropped deterministically (counted ``link_down_pkts``, never
+    in ``pkts_lost``); otherwise the Bernoulli loss draw applies at the
+    path's threshold — replaced by an active timed loss ramp's, same coin
+    bits either way. Returns (flat_packets, n_sent, n_lost, n_linkdown)."""
     cap, h = ob.dst.shape
     mask = jnp.arange(cap)[:, None] < ob.cnt[None, :]
     src = jnp.broadcast_to(ctx.hosts[None, :], (cap, h))
@@ -336,17 +360,28 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray, jnp.nd
         jit = ctx.jitter_vv[vs, vd]
         jbits = rng.bits_v(ctx.key, R_JITTER, fsrc, fctr)
         arrival = arrival + rng.randint(jbits, 2 * jit + 1).astype(jnp.int64) - jit
+    linkdown = jnp.zeros_like(fmask)
+    if ctx.has_link_fault:
+        from shadow1_tpu.fault.plane import link_down_mask
+
+        linkdown = fmask & link_down_mask(ctx.link_fault, vs, vd, fdep)
+    thr = ctx.loss_thr_vv[vs, vd]
+    if ctx.has_loss_ramp:
+        from shadow1_tpu.fault.plane import ramp_loss_thr
+
+        thr = ramp_loss_thr(ctx.loss_ramp, vs, vd, fdep, thr)
     bits = rng.bits_v(ctx.key, R_LOSS, fsrc, fctr)
     # Integer Bernoulli on precomputed thresholds (rng.prob_threshold) —
     # shared with the CPU oracle, backend-exact by construction.
-    lost = fmask & rng.uniform_lt(bits, ctx.loss_thr_vv[vs, vd])
-    keep = fmask & ~lost
+    lost = fmask & ~linkdown & rng.uniform_lt(bits, thr)
+    keep = fmask & ~lost & ~linkdown
     tb = packet_tb(fsrc.astype(jnp.int64), fctr)
     fp = FlatPackets(
         dst=fdst_safe, arrival=arrival, tb=tb, kind=flat(ob.kind), p=flat(ob.p),
         keep=keep,
     )
-    return fp, fmask.sum(dtype=jnp.int64), lost.sum(dtype=jnp.int64)
+    return (fp, fmask.sum(dtype=jnp.int64), lost.sum(dtype=jnp.int64),
+            linkdown.sum(dtype=jnp.int64))
 
 
 def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
@@ -354,17 +389,21 @@ def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
 
     Maps global dst ids onto the local block (contiguous range starting at
     ctx.hosts[0]); packets for other blocks are masked out; packets whose
-    arrival is past the destination's stop_time are dropped here (churn —
-    counted, never delivered, so a stopped host's buffers stay clean).
-    Returns (evbuf, n_delivered, n_overflow, n_down) counting only this
-    block's packets."""
+    arrival falls inside a down interval of the destination are dropped
+    here (churn — counted, never delivered, so a dead host's buffers stay
+    clean). Returns (evbuf, n_delivered, n_overflow, n_down) counting only
+    this block's packets."""
     base = ctx.hosts[0].astype(fp.dst.dtype)
     local = fp.dst - base
     mine = fp.keep & (local >= 0) & (local < ctx.n_hosts)
     local = jnp.where(mine, local, 0)
     n_down = jnp.zeros((), jnp.int64)
     if ctx.has_stop:
-        to_down = mine & (fp.arrival >= ctx.stop_time[local])
+        from shadow1_tpu.fault.plane import hosts_down_at_idx
+
+        to_down = mine & hosts_down_at_idx(
+            ctx.fault_down, ctx.fault_up, local, fp.arrival
+        )
         n_down = to_down.sum(dtype=jnp.int64)
         mine = mine & ~to_down
     evbuf, n_over = deliver_batch(
@@ -381,7 +420,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     the host axis when sharded — the one collective per window, SURVEY §2.5)."""
     from shadow1_tpu.core.outbox import outbox_fill
 
-    fp, n_sent, n_lost = route_outbox(ctx, st.outbox)
+    fp, n_sent, n_lost, n_linkdown = route_outbox(ctx, st.outbox)
     ob_fill = outbox_fill(st.outbox)  # maintained [H] counter — before clear
     n_x2x = x2x_hw = jnp.zeros((), jnp.int64)
     if exchange is not None:
@@ -400,6 +439,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             x2x_max_fill=jnp.maximum(m.x2x_max_fill, x2x_hw),
             ob_max_fill=jnp.maximum(m.ob_max_fill, ob_fill),
             down_pkts=m.down_pkts + n_down,
+            link_down_pkts=m.link_down_pkts + n_linkdown,
         ),
     )
 
@@ -451,6 +491,26 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     # knob is on AND a ring exists to carry the words — state_digest=0
     # (default) adds zero ops here and zero ops anywhere else.
     digest_on = bool(ctx.params.state_digest) and st.telem is not None
+    if ctx.has_restart:
+        # Host restart (fault plane): hosts whose window-quantized up time
+        # IS this window's start get their model columns (tcp socks, nic
+        # clocks/counters, app state) restored to the post-init capture and
+        # their virtual-CPU clock zeroed — BEFORE this window's rounds, so
+        # events timed at/after the restart execute against fresh state.
+        # The event buffer is deliberately untouched: stale events are a
+        # pure function of time (dead-interval ones discard at pop), so
+        # the oracle's eager heap and this batched reset stay bit-equal.
+        from shadow1_tpu.fault.plane import reset_host_columns, restart_mask
+
+        rs = restart_mask(ctx.fault_up, st.win_start)
+        mr = st.metrics
+        st = st._replace(
+            model=reset_host_columns(st.model, ctx.init_model, rs,
+                                     ctx.n_hosts),
+            cpu_busy=jnp.where(rs, 0, st.cpu_busy),
+            metrics=mr._replace(
+                host_restarts=mr.host_restarts + rs.sum(dtype=jnp.int64)),
+        )
     win_end = st.win_start + ctx.window
     if pre_window is not None:
         st = pre_window(st, ctx, win_end)
@@ -560,13 +620,29 @@ def aqm_tables_np(exp) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
 
 def fidelity_ctx_kwargs(exp) -> dict:
     """The Ctx fidelity fields + static has_* flags from a CompiledExperiment
-    (shared by Engine and ShardedEngine; everything numpy → device const)."""
+    (shared by Engine and ShardedEngine; everything numpy → device const).
+    The fault plane compiles here too: host down/up intervals (legacy
+    stop_time merged in), link-outage and loss-ramp tables — one builder
+    (fault/schedule.py) shared with the CPU oracle."""
     from shadow1_tpu.config.compiled import NO_STOP
+    from shadow1_tpu.fault.schedule import (
+        host_interval_tensors,
+        link_tables,
+        ramp_tables,
+    )
 
     aqm_min_ns, aqm_span_ns, aqm_pmax_thr = aqm_tables_np(exp)
+    fault_down, fault_up = host_interval_tensors(exp)
+    lf = link_tables(exp)
+    rt = ramp_tables(exp)
     return dict(
         jitter_vv=jnp.asarray(exp.jitter_vv, jnp.int64),
-        stop_time=jnp.asarray(exp.stop_time, jnp.int64),
+        fault_down=jnp.asarray(fault_down),
+        fault_up=jnp.asarray(fault_up),
+        link_fault=(tuple(jnp.asarray(a) for a in lf)
+                    if lf is not None else None),
+        loss_ramp=(tuple(jnp.asarray(a) for a in rt)
+                   if rt is not None else None),
         cpu_cost=jnp.asarray(exp.cpu_ns_per_event, jnp.int64),
         tx_qlen_ns=jnp.asarray(qlen_ns_np(exp.tx_qlen_bytes, exp.bw_up)),
         rx_qlen_ns=jnp.asarray(qlen_ns_np(exp.rx_qlen_bytes, exp.bw_dn)),
@@ -574,7 +650,10 @@ def fidelity_ctx_kwargs(exp) -> dict:
         aqm_span_ns=jnp.asarray(aqm_span_ns),
         aqm_pmax_thr=jnp.asarray(aqm_pmax_thr),
         has_jitter=bool(exp.jitter_vv.max() > 0),
-        has_stop=bool(exp.stop_time.min() < NO_STOP),
+        has_stop=bool(fault_down.min() < NO_STOP),
+        has_restart=bool((fault_up < NO_STOP).any()),
+        has_link_fault=lf is not None,
+        has_loss_ramp=rt is not None,
         has_cpu=bool(exp.cpu_ns_per_event.max() > 0),
         has_tx_qlen=bool(exp.tx_qlen_bytes.max() > 0),
         has_rx_qlen=bool(exp.rx_qlen_bytes.max() > 0),
@@ -659,6 +738,19 @@ class Engine:
             **fidelity_ctx_kwargs(exp),
         )
         self._model = _model_module(exp.model)
+        if self.ctx.has_restart:
+            # Restart target: the model pytree exactly as init() builds it
+            # (tcp listen sockets included), materialized once and closed
+            # over as device constants — window_step restores restarted
+            # hosts' columns from it (fault/plane.reset_host_columns).
+            model0, _, _ = self._model.init(
+                self.ctx, evbuf_init(exp.n_hosts, self.params.ev_cap)
+            )
+            self.ctx = dataclasses.replace(
+                self.ctx,
+                init_model=jax.tree.map(lambda x: jnp.asarray(np.asarray(x)),
+                                        model0),
+            )
         self._handlers = self._model.make_handlers(self.ctx)
         self._pre_window = getattr(self._model, "make_pre_window", lambda c: None)(
             self.ctx

@@ -61,9 +61,25 @@ class CpuEngine:
         self._ob_win = np.full(h, -1, np.int64)  # outbox accounting: window idx
         self._ob_used = np.zeros(h, np.int64)    # ... sends used this window
         # Fidelity mirrors (docs/SEMANTICS.md; identical rules to run_round /
-        # route_outbox / deliver_flat in core/engine.py).
-        self.stop_time = np.asarray(exp.stop_time, np.int64)
-        self.has_stop = bool(self.stop_time.min() < (1 << 62))
+        # route_outbox / deliver_flat in core/engine.py). The fault plane
+        # compiles through the SAME builders as the batched engines
+        # (fault/schedule.py), so down predicates, outage windows and ramp
+        # thresholds are the identical integers.
+        from shadow1_tpu.config.compiled import NO_STOP
+        from shadow1_tpu.fault.schedule import (
+            host_interval_tensors,
+            hosts_down_at_np,
+            link_tables,
+            ramp_tables,
+        )
+
+        self._hosts_down_at_np = hosts_down_at_np  # hot path: bind once
+        self.fault_down, self.fault_up = host_interval_tensors(exp)
+        self.has_stop = bool(self.fault_down.min() < NO_STOP)
+        self._link_fault = link_tables(exp)
+        self._loss_ramp = ramp_tables(exp)
+        self.has_link_fault = self._link_fault is not None
+        self.has_loss_ramp = self._loss_ramp is not None
         self.cpu_cost = np.asarray(exp.cpu_ns_per_event, np.int64)
         self.has_cpu = bool(self.cpu_cost.max() > 0)
         self.cpu_busy = np.zeros(h, np.int64)
@@ -78,6 +94,8 @@ class CpuEngine:
             "ob_overflow": 0,
             "down_events": 0,
             "down_pkts": 0,
+            "link_down_pkts": 0,
+            "host_restarts": 0,
             "nic_tx_drops": 0,
             "nic_rx_drops": 0,
             "nic_aqm_drops": 0,
@@ -113,6 +131,25 @@ class CpuEngine:
         self.digest_rows: list[dict] = []
         self.model = self._make_model()
         self.model.start()
+        # Host restart schedule (fault plane): every finite window-quantized
+        # up boundary, sorted — a restarted host's model columns restore to
+        # the POST-start snapshot captured here (the oracle twin of the
+        # batched engines' init-model capture: same moment in the lifecycle,
+        # before any event has run), its virtual-CPU clock zeroes, and its
+        # event heap entries are deliberately untouched (dead-interval ones
+        # discard at pop; later ones execute against the reset state).
+        self._restart_sched: list[tuple[int, list[int]]] = []
+        self._restart_i = 0
+        self._cur_end = 0
+        if (self.fault_up < NO_STOP).any():
+            by_b: dict[int, list[int]] = {}
+            ks, hs = np.nonzero(self.fault_up < NO_STOP)
+            for k, h_ in zip(ks, hs):
+                by_b.setdefault(int(self.fault_up[k, h_]), []).append(int(h_))
+            self._restart_sched = sorted(
+                (b, sorted(v)) for b, v in by_b.items()
+            )
+            self._restart_snap = self.model.snapshot_host_state()
 
     def _make_model(self):
         if self.exp.model == "phold":
@@ -122,6 +159,43 @@ class CpuEngine:
 
             return CpuNetModel(self)
         raise ValueError(f"unknown model {self.exp.model!r}")
+
+    # -- fault plane (mirrors fault/plane.py on the shared tables) --------
+    def _down_at(self, host: int, t: int) -> bool:
+        """Host ``host`` is down at time ``t`` (any interval contains t)."""
+        return self._hosts_down_at_np(self.fault_down, self.fault_up, host, t)
+
+    def _link_down(self, vs: int, vd: int, dep: int) -> bool:
+        src, dst, t0, t1 = self._link_fault
+        return bool(((vs == src) & (vd == dst)
+                     & (dep >= t0) & (dep < t1)).any())
+
+    def _ramp_thr(self, vs: int, vd: int, dep: int, thr: int) -> int:
+        src, dst, t0, t1, rthr = self._loss_ramp
+        for i in range(len(src)):
+            if (vs == src[i] and vd == dst[i]
+                    and t0[i] <= dep < t1[i]):
+                thr = int(rthr[i])  # entries in order: later wins
+        return thr
+
+    def _apply_restarts_pending(self, upto: int) -> bool:
+        """Apply every scheduled restart whose boundary b satisfies
+        b ≤ upto, b < the current run end (the batched engine only runs
+        window starts < end), and b < the next UNprocessed boundary (its
+        digest row — the pre-restart state — must already be emitted).
+        Returns True if any host was reset (digest planes then stale)."""
+        applied = False
+        while self._restart_i < len(self._restart_sched):
+            b, hosts = self._restart_sched[self._restart_i]
+            if b > upto or b >= self._cur_end or b >= self._next_boundary:
+                break
+            for h_ in hosts:
+                self.model.reset_host(h_, self._restart_snap)
+                self.cpu_busy[h_] = 0
+                self.metrics["host_restarts"] += 1
+            self._restart_i += 1
+            applied = True
+        return applied
 
     # -- scheduling primitives (semantics shared with the TPU engine) -----
     def schedule_local(self, host: int, time: int, kind: int, p: tuple) -> None:
@@ -167,7 +241,18 @@ class CpuEngine:
         self.metrics["pkts_sent"] += 1
         vs = int(self.exp.host_vertex[src])
         vd = int(self.exp.host_vertex[dst])
-        if int(self.draws.bits(R_LOSS, src, ctr)) < int(self.loss_thr[vs, vd]):
+        if self.has_link_fault and self._link_down(vs, vd, depart):
+            # Link outage (fault plane): deterministic drop on departure,
+            # BEFORE the loss draw — counted separately, never in
+            # pkts_lost (route_outbox orders the gates identically).
+            self.metrics["link_down_pkts"] += 1
+            if self.capture is not None:
+                self.capture(depart, src, dst, p, True)
+            return True
+        thr = int(self.loss_thr[vs, vd])
+        if self.has_loss_ramp:
+            thr = self._ramp_thr(vs, vd, depart, thr)
+        if int(self.draws.bits(R_LOSS, src, ctr)) < thr:
             self.metrics["pkts_lost"] += 1
             if self.capture is not None:
                 self.capture(depart, src, dst, p, True)
@@ -177,7 +262,7 @@ class CpuEngine:
             jit = int(self.jitter_vv[vs, vd])
             if jit:
                 arrival += self.draws.randint(R_JITTER, src, ctr, 2 * jit + 1) - jit
-        if self.has_stop and arrival >= self.stop_time[dst]:
+        if self.has_stop and self._down_at(dst, arrival):
             self.metrics["down_pkts"] += 1
             return True
         if self.pending[dst] >= self.params.ev_cap:
@@ -215,7 +300,11 @@ class CpuEngine:
         sees exactly the state the batch engine gauges at window end —
         and, with digests on, exactly the state the batch engine digests
         there (docs/SEMANTICS.md: the boundary pending/live sets are
-        engine-independent)."""
+        engine-independent). Host restart resets interleave here in
+        boundary order: a restart at boundary b applies AFTER the digest
+        row for window b/W−1 (the pre-restart state) and before any event
+        with time ≥ b — exactly where window_step applies it."""
+        self._apply_restarts_pending(upto)
         if self._next_boundary > upto:
             return
         fill = int(self.pending.max()) if self.pending.size else 0
@@ -224,16 +313,19 @@ class CpuEngine:
         if not self.digest_on:
             n_skipped = (upto - self._next_boundary) // self.window + 1
             self._next_boundary += n_skipped * self.window
+            self._apply_restarts_pending(upto)
             return
         # One row per boundary window. The plane digests are static across
-        # a multi-boundary stretch (no event ran in between) — computed
-        # once; only the per-window outbox sums differ (0 for idle windows,
-        # matching the TPU's empty-outbox digest).
+        # a multi-boundary stretch (no event ran in between, and no restart
+        # fired — a restart invalidates the cache) — computed once; only
+        # the per-window outbox sums differ (0 for idle windows, matching
+        # the TPU's empty-outbox digest).
         from shadow1_tpu.telemetry.registry import REC_DIGEST
 
         dg_tcp, dg_nic, dg_rng = self._digest_planes()
         while self._next_boundary <= upto:
-            w = self._next_boundary // self.window - 1
+            b = self._next_boundary
+            w = b // self.window - 1
             self.digest_rows.append({
                 "type": REC_DIGEST,
                 "window": w,
@@ -244,6 +336,8 @@ class CpuEngine:
                 "dg_rng": dg_rng,
             })
             self._next_boundary += self.window
+            if self._apply_restarts_pending(b):
+                dg_tcp, dg_nic, dg_rng = self._digest_planes()
 
     def _digest_planes(self) -> tuple[int, int, int]:
         """(dg_tcp, dg_nic, dg_rng) of the CURRENT state — the oracle twins
@@ -274,6 +368,10 @@ class CpuEngine:
     # -- main loop ---------------------------------------------------------
     def run(self, n_windows: int | None = None) -> dict[str, Any]:
         end = (self.n_windows if n_windows is None else n_windows) * self.window
+        # Restart resets apply only at window starts the batched engine
+        # actually runs (win_start < end); a boundary AT the run end defers
+        # to a later run() continuation (paritytrace's lockstep chunks).
+        self._cur_end = max(self._cur_end, end)
         rx_batch = getattr(self.model, "rx_batch", False)
         while self.heap and self.heap[0][0] < end:
             self._sample_fill(int(self.heap[0][0]))
@@ -281,8 +379,8 @@ class CpuEngine:
             self.pending[host] -= 1
             if self.digest_on:
                 self._ev_dg -= self._ev_word.pop(_g)
-            # churn: a stopped host discards its events (core run_round rule)
-            if self.has_stop and time >= self.stop_time[host]:
+            # churn: a dead host discards its events (core run_round rule)
+            if self.has_stop and self._down_at(host, time):
                 self.metrics["down_events"] += 1
                 continue
             # NIC arrival fast path: rx processing is plumbing, not an event
@@ -314,6 +412,23 @@ class CpuEngine:
         return self.model.summary()
 
 
+def snap_host_arrays(obj, n_hosts: int) -> dict[str, np.ndarray]:
+    """Copy every per-host numpy attribute of ``obj`` (host axis 0 — the
+    oracle layout convention, transposed from the batch engines' host-minor
+    tensors). Config arrays that happen to match are harmless: restoring a
+    never-mutated array is the identity, exactly like the batched engines'
+    whole-model column reset (fault/plane.reset_host_columns)."""
+    return {
+        k: v.copy() for k, v in vars(obj).items()
+        if isinstance(v, np.ndarray) and v.ndim >= 1 and v.shape[0] == n_hosts
+    }
+
+
+def reset_host_arrays(obj, snap: dict[str, np.ndarray], host: int) -> None:
+    for k, v in snap.items():
+        getattr(obj, k)[host] = v[host]
+
+
 class CpuPhold:
     """Oracle PHOLD (semantics mirror of shadow1_tpu.core.phold)."""
 
@@ -329,6 +444,13 @@ class CpuPhold:
         for h in range(self.eng.exp.n_hosts):
             for _ in range(self.init_events):
                 self.eng.schedule_local(h, 0, K_PHOLD, ())
+
+    # -- fault-plane restart (mirror of the init-model column reset) ------
+    def snapshot_host_state(self):
+        return snap_host_arrays(self, self.eng.exp.n_hosts)
+
+    def reset_host(self, host: int, snap) -> None:
+        reset_host_arrays(self, snap, host)
 
     def handle(self, host: int, time: int, kind: int, p: tuple) -> None:
         d = self.eng.draws

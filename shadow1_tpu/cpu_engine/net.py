@@ -64,7 +64,7 @@ RCV_STATES = set(TCP_RCV_STATES)
 
 class CpuSock:
     __slots__ = (
-        "st", "peer_host", "peer_sock", "snd_una", "snd_nxt", "rcv_nxt",
+        "st", "peer_host", "peer_sock", "snd_una", "snd_nxt", "snd_max", "rcv_nxt",
         "app_end", "fin_pend", "cwnd", "ssthresh", "peer_wnd", "dupacks",
         "recover", "srtt", "rttvar", "rto", "rtx_t", "timer_armed",
         "ts_act", "ts_seq", "ts_time", "txr", "mq",
@@ -76,6 +76,7 @@ class CpuSock:
         self.peer_sock = 0
         self.snd_una = 0
         self.snd_nxt = 0
+        self.snd_max = 0
         self.rcv_nxt = 0
         self.app_end = 0
         self.fin_pend = 0
@@ -101,6 +102,7 @@ class CpuSock:
         self.peer_sock = peer_sock
         self.snd_una = 0
         self.snd_nxt = 0
+        self.snd_max = 0
         self.rcv_nxt = rcv_nxt
         self.app_end = 1
         self.fin_pend = 0
@@ -176,6 +178,40 @@ class CpuNetModel:
 
     def start(self):
         self.app.start()
+
+    # ------------------------------------------------------------------
+    # Fault-plane restart (mirror of fault/plane.reset_host_columns over
+    # the batched engines' init-model capture: NIC clocks/counters, every
+    # socket — listen state included — and all per-host app state restore
+    # to their post-start values; engine-level event/tb counters and the
+    # pending heap are deliberately NOT touched, on either engine).
+    # ------------------------------------------------------------------
+    def snapshot_host_state(self):
+        from shadow1_tpu.cpu_engine.engine import snap_host_arrays
+
+        socks = [
+            [
+                {f: (list(getattr(k, f)) if f == "mq" else getattr(k, f))
+                 for f in CpuSock.__slots__}
+                for k in per_host
+            ]
+            for per_host in self.socks
+        ]
+        return {
+            "nic": snap_host_arrays(self, self.n_hosts),
+            "app": snap_host_arrays(self.app, self.n_hosts),
+            "socks": socks,
+        }
+
+    def reset_host(self, host: int, snap) -> None:
+        from shadow1_tpu.cpu_engine.engine import reset_host_arrays
+
+        reset_host_arrays(self, snap["nic"], host)
+        reset_host_arrays(self.app, snap["app"], host)
+        for s, d in enumerate(snap["socks"][host]):
+            k = self.socks[host][s]
+            for f, v in d.items():
+                setattr(k, f, list(v) if f == "mq" else v)
 
     # ------------------------------------------------------------------
     # NIC + packet emission (mirror of tcp.py _emit / net.udp_send)
@@ -287,6 +323,8 @@ class CpuNetModel:
                     length = best[0]
             self.emit(h, s, flags, k.snd_nxt, length, mend, mmeta, now)
             k.snd_nxt = seq_add(k.snd_nxt, length + (1 if (seg_syn or seg_fin) else 0))
+            if seq_lt(k.snd_max, k.snd_nxt):
+                k.snd_max = k.snd_nxt
             if not k.ts_act:
                 k.ts_act = True
                 k.ts_seq = k.snd_nxt
@@ -428,8 +466,11 @@ class CpuNetModel:
 
         state = k.st  # pre-transition snapshot (mirrors the vector code)
         snd_una0, snd_nxt0 = k.snd_una, k.snd_nxt
+        snd_max0 = k.snd_max
         a = is_ack
-        new_ack = a and seq_lt(snd_una0, ackno) and seq_le(ackno, snd_nxt0)
+        # Acceptance tests against snd_max (highest ever sent), NOT the
+        # possibly-rewound snd_nxt — mirror of tcp.py (outage deadlock).
+        new_ack = a and seq_lt(snd_una0, ackno) and seq_le(ackno, snd_max0)
         est_ss = a and is_syn and state == TCP_SYN_SENT and ackno == 1
         frx = False
         if new_ack:
@@ -446,9 +487,11 @@ class CpuNetModel:
             grow = pr.mss if k.cwnd < k.ssthresh else max((pr.mss * pr.mss) // max(k.cwnd, 1), 1)
             k.cwnd = min(k.cwnd + grow, CWND_MAX)
             k.snd_una = ackno
+            if seq_lt(k.snd_nxt, ackno):
+                k.snd_nxt = ackno  # acked bytes were sent pre-rewind
             k.dupacks = 0
             k.mq = [(e, m) for (e, m) in k.mq if seq_lt(ackno, e)]
-            outstanding = seq_lt(ackno, snd_nxt0)
+            outstanding = seq_lt(ackno, snd_max0)
             k.rtx_t = (now + k.rto) if outstanding else 0
             if state == TCP_SYN_RCVD:
                 k.st = TCP_ESTABLISHED
@@ -472,7 +515,7 @@ class CpuNetModel:
         else:
             closed_by_ack = False
         dup_a = (
-            a and not new_ack and ackno == snd_una0 and seq_lt(ackno, snd_nxt0)
+            a and not new_ack and ackno == snd_una0 and seq_lt(ackno, snd_max0)
             and length == 0 and not is_syn and not is_fin
         )
         if dup_a:
@@ -537,7 +580,7 @@ class CpuNetModel:
             k.timer_armed = True
             self.eng.schedule_local(h, k.rtx_t, K_TCP_TIMER, (s,))
             return
-        outstanding = seq_lt(k.snd_una, k.snd_nxt)
+        outstanding = seq_lt(k.snd_una, k.snd_max)
         if outstanding and k.st in SENDABLE:
             flight = seq_sub(k.snd_nxt, k.snd_una)
             k.ssthresh = max(flight // 2, 2 * pr.mss)

@@ -1,0 +1,18 @@
+"""Deterministic fault plane — simulated host churn and link failure.
+
+The reference schedules host lifetimes in its experiment file precisely so
+churn experiments are reproducible (config start/shutdown times,
+src/main/core/support/configuration.c); this package is the tensorized
+generalization: a ``faults:`` config section compiles to dense device
+tensors (``schedule.py``) that the engines apply with zero host syncs
+(``plane.py`` holds the traced helpers; the CPU oracle mirrors the same
+numpy tables). Semantics contract: docs/SEMANTICS.md §"Fault plane".
+"""
+
+from shadow1_tpu.fault.schedule import (  # noqa: F401
+    FaultSchedule,
+    host_interval_tensors,
+    link_tables,
+    parse_faults,
+    ramp_tables,
+)

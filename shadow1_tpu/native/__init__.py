@@ -128,6 +128,7 @@ def dump_net_config(exp, params, n_windows: int, path: str) -> None:
 
     for knob, name in (
         (np.asarray(exp.stop_time).min() < (1 << 62), "host stop times"),
+        (getattr(exp, "faults", None) is not None, "fault schedule"),
         (np.asarray(exp.cpu_ns_per_event).max() > 0, "virtual CPU"),
         (np.asarray(exp.tx_qlen_bytes).max() > 0, "tx queue bound"),
         (np.asarray(exp.rx_qlen_bytes).max() > 0, "rx queue bound"),

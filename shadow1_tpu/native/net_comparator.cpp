@@ -204,7 +204,7 @@ struct Metrics {
 
 struct Sock {
   int32_t st = TCP_FREE, peer_host = 0, peer_sock = 0;
-  uint32_t snd_una = 0, snd_nxt = 0, rcv_nxt = 0, app_end = 0;
+  uint32_t snd_una = 0, snd_nxt = 0, snd_max = 0, rcv_nxt = 0, app_end = 0;
   int32_t fin_pend = 0;
   int64_t cwnd = 0, ssthresh = 0, peer_wnd = 0;
   int32_t dupacks = 0;
@@ -412,6 +412,7 @@ struct Engine {
       }
       emit(h, s, flags, k.snd_nxt, length, mend, mmeta, now);
       k.snd_nxt = seq_add(k.snd_nxt, length + ((seg_syn || seg_fin) ? 1 : 0));
+      if (seq_lt(k.snd_max, k.snd_nxt)) k.snd_max = k.snd_nxt;
       if (!k.ts_act) {
         k.ts_act = true;
         k.ts_seq = k.snd_nxt;
@@ -453,7 +454,7 @@ struct Engine {
     k.st = state;
     k.peer_host = peer_host;
     k.peer_sock = peer_sock;
-    k.snd_una = k.snd_nxt = 0;
+    k.snd_una = k.snd_nxt = k.snd_max = 0;
     k.rcv_nxt = rcv_nxt;
     k.app_end = 1;
     k.fin_pend = 0;
@@ -546,7 +547,10 @@ struct Engine {
 
     int state = k.st;
     uint32_t snd_una0 = k.snd_una, snd_nxt0 = k.snd_nxt;
-    bool new_ack = is_ack && seq_lt(snd_una0, ackno) && seq_le(ackno, snd_nxt0);
+    uint32_t snd_max0 = k.snd_max;
+    // ACK acceptance tests against snd_max (highest ever sent), not the
+    // possibly-rewound snd_nxt — mirror of tcp.py (outage deadlock fix).
+    bool new_ack = is_ack && seq_lt(snd_una0, ackno) && seq_le(ackno, snd_max0);
     bool est_ss = is_ack && is_syn && state == TCP_SYN_SENT && ackno == 1;
     bool frx = false;
     bool closed_by_ack = false;
@@ -576,6 +580,7 @@ struct Engine {
                                              1);
       k.cwnd = std::min<int64_t>(k.cwnd + grow, CWND_MAX);
       k.snd_una = ackno;
+      if (seq_lt(k.snd_nxt, ackno)) k.snd_nxt = ackno;
       k.dupacks = 0;
       {
         size_t w = 0;
@@ -583,7 +588,7 @@ struct Engine {
           if (seq_lt(ackno, k.mq[i].first)) k.mq[w++] = k.mq[i];
         k.mq.resize(w);
       }
-      bool outstanding = seq_lt(ackno, snd_nxt0);
+      bool outstanding = seq_lt(ackno, snd_max0);
       k.rtx_t = outstanding ? now + k.rto : 0;
       if (state == TCP_SYN_RCVD) { k.st = TCP_ESTABLISHED; notifs |= N_ACCEPTED; }
     }
@@ -601,7 +606,7 @@ struct Engine {
         notifs |= N_SPACE;
     }
     bool dup_a = is_ack && !new_ack && ackno == snd_una0 &&
-                 seq_lt(ackno, snd_nxt0) && length == 0 && !is_syn && !is_fin;
+                 seq_lt(ackno, snd_max0) && length == 0 && !is_syn && !is_fin;
     if (dup_a) {
       k.dupacks++;
       if (k.dupacks == c.dupack_thresh && seq_le(k.recover, snd_una0)) {
@@ -653,7 +658,7 @@ struct Engine {
       schedule_local1(h, k.rtx_t, K_TCP_TIMER, s);
       return;
     }
-    bool outstanding = seq_lt(k.snd_una, k.snd_nxt);
+    bool outstanding = seq_lt(k.snd_una, k.snd_max);
     if (outstanding && sendable(k.st)) {
       int64_t flight = seq_sub(k.snd_nxt, k.snd_una);
       k.ssthresh = std::max<int64_t>(flight / 2, 2 * c.mss);

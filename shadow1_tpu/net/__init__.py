@@ -144,9 +144,11 @@ def make_pre_window(ctx):
         kind0, time0 = buf.kind, abs_t
         m = st.metrics
         if ctx.has_stop:
-            # A stopped host discards arrivals unprocessed (run_round rule);
+            from shadow1_tpu.fault.plane import hosts_down_at
+
+            # A dead host discards arrivals unprocessed (run_round rule);
             # they must not reserve the downlink.
-            down = sel & (abs_t >= ctx.stop_time[None, :])
+            down = sel & hosts_down_at(ctx.fault_down, ctx.fault_up, abs_t)
             sel = sel & ~down
             kind0 = jnp.where(down, K_NONE, kind0)
             time0 = jnp.where(down, I64_MAX, time0)

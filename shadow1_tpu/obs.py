@@ -109,6 +109,15 @@ class Heartbeat:
 
         drops = {f: delta.pop(f, 0) for f in DROP_FIELDS}
         rec["drops"] = {"total": sum(drops.values()), **drops}
+        # Fault plane: when churn/outage activity happened this chunk, a
+        # ``faults`` block surfaces it directly (restart resets plus the
+        # fault-induced rows of the drops table) — docs/OBSERVABILITY.md.
+        restarts = delta.pop("host_restarts", 0)
+        fault_drops = {k: drops[k] for k in
+                       ("down_events", "down_pkts", "link_down_pkts")
+                       if k in drops}
+        if restarts or any(fault_drops.values()):
+            rec["faults"] = {"host_restarts": restarts, **fault_drops}
         # Capacity occupancy: run-max fill gauges against their caps — the
         # data the cap controller and tools/captune.py size caps from.
         # High-water marks, not rates: they leave ``delta`` and ride a
@@ -216,6 +225,14 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     def on_chunk(s, done):
         nonlocal last_save
         hb(s, done)
+        # Fault injection, pre-save flavor: die BEFORE the checkpoint is
+        # written — the supervisor then sees a crash with zero recorded
+        # progress, which is what its failure classifier must recognize
+        # after two identical attempts (cli._supervise). Inert without the
+        # env var; the post-save hook below models the wedge-after-save.
+        crash_pre = os.environ.get("SHADOW1_OBS_CRASH_PRE_SAVE_AT_NS")
+        if crash_pre is not None and int(s.win_start) == int(crash_pre):
+            os._exit(41)
         now = time.perf_counter()
         if done >= total or now - last_save > ckpt_every_s:
             with maybe_span(profiler, PH_CHECKPOINT):

@@ -129,6 +129,17 @@ class ShardedEngine:
             **fidelity_ctx_kwargs(exp),
         )
         self._model = _model_module(exp.model)
+        # Restart target for the fault plane (mirrors Engine.__init__): the
+        # post-init model pytree, kept as a HOST-side numpy tree here and
+        # passed through shard_map with the state's specs so each block
+        # restores from its own host columns.
+        self._init_model = None
+        if self.global_ctx.has_restart:
+            model0, _, _ = self._model.init(
+                self.global_ctx,
+                evbuf_init(exp.n_hosts, self.params.ev_cap),
+            )
+            self._init_model = jax.tree.map(np.asarray, model0)
         # Per-(src→dst shard) bucket capacity. The worst case is convergent
         # traffic: ONE bucket holding the shard's entire outbox, so
         # ``_full_cap`` always fits by construction. The auto default is 2×
@@ -220,22 +231,31 @@ class ShardedEngine:
         loss_thr_vv = self.global_ctx.loss_thr_vv
         host_vertex = self.global_ctx.host_vertex  # full, replicated
         gctx = self.global_ctx
-        # Per-host columns sharded alongside the state (P(axis) each).
+        # Per-host columns sharded alongside the state (host-minor last
+        # axis, P(..., axis) each — fault_down/fault_up are [K, H]).
         cols_g = dict(
             hosts=gctx.hosts, bw_up=gctx.bw_up, bw_dn=gctx.bw_dn,
-            stop_time=gctx.stop_time, cpu_cost=gctx.cpu_cost,
+            fault_down=gctx.fault_down, fault_up=gctx.fault_up,
+            cpu_cost=gctx.cpu_cost,
             tx_qlen_ns=gctx.tx_qlen_ns, rx_qlen_ns=gctx.rx_qlen_ns,
             aqm_min_ns=gctx.aqm_min_ns, aqm_span_ns=gctx.aqm_span_ns,
             aqm_pmax_thr=gctx.aqm_pmax_thr,
         )
         flags = dict(
             has_jitter=gctx.has_jitter, has_stop=gctx.has_stop,
+            has_restart=gctx.has_restart,
+            has_link_fault=gctx.has_link_fault,
+            has_loss_ramp=gctx.has_loss_ramp,
             has_cpu=gctx.has_cpu, has_tx_qlen=gctx.has_tx_qlen,
             has_rx_qlen=gctx.has_rx_qlen, has_aqm=gctx.has_aqm,
         )
         jitter_vv = gctx.jitter_vv
+        # Vertex-keyed fault tables are tiny and host-free: replicated
+        # closure constants, like lat_vv.
+        link_fault, loss_ramp = gctx.link_fault, gctx.loss_ramp
+        init_model_g = self._init_model
 
-        def block(st: SimState, cols, n_windows) -> SimState:
+        def block(st: SimState, cols, imodel, n_windows) -> SimState:
             ctx = Ctx(
                 n_hosts=h_local,
                 n_total=exp.n_hosts,
@@ -251,7 +271,11 @@ class ShardedEngine:
                 hosts=cols["hosts"],
                 loss_thr_vv=loss_thr_vv,
                 jitter_vv=jitter_vv,
-                stop_time=cols["stop_time"],
+                fault_down=cols["fault_down"],
+                fault_up=cols["fault_up"],
+                link_fault=link_fault,
+                loss_ramp=loss_ramp,
+                init_model=imodel,
                 cpu_cost=cols["cpu_cost"],
                 tx_qlen_ns=cols["tx_qlen_ns"],
                 rx_qlen_ns=cols["rx_qlen_ns"],
@@ -388,14 +412,18 @@ class ShardedEngine:
 
         def run(st: SimState, n_windows) -> SimState:
             specs = self._state_specs(st)
-            col_specs = {k: P(axis) for k in cols_g}
+            col_specs = {
+                k: P(*([None] * (v.ndim - 1)), axis)
+                for k, v in cols_g.items()
+            }
+            imodel_specs = jax.tree.map(self._spec_for, init_model_g)
             f = _shard_map(
                 block,
                 mesh=self.mesh,
-                in_specs=(specs, col_specs, P()),
+                in_specs=(specs, col_specs, imodel_specs, P()),
                 out_specs=specs,
             )
-            return f(st, cols_g, n_windows)
+            return f(st, cols_g, init_model_g, n_windows)
 
         return run
 

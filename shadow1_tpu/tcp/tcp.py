@@ -82,10 +82,18 @@ from shadow1_tpu.net.nic import ctx_aqm, tx_stamp
 # Fields of the TCP state dict, all [S, H] unless noted.
 _FIELDS_I32 = (
     "st", "peer_host", "peer_sock",
-    "snd_una", "snd_nxt", "rcv_nxt", "app_end",   # seq space (u32 wrap)
+    "snd_una", "snd_nxt", "snd_max", "rcv_nxt", "app_end",  # seq (u32 wrap)
     "fin_pend", "cwnd", "ssthresh", "peer_wnd",
     "dupacks", "recover", "ts_seq", "txr",
 )
+# ``snd_max`` is the highest sequence ever sent (RFC 793's SND.NXT before
+# any Go-Back-N rewind). Cumulative-ACK acceptance must test against it,
+# not the rewound snd_nxt: after an RTO rewinds snd_nxt to snd_una, the
+# receiver may legitimately ACK data it got BEFORE the loss event — with
+# random loss the window's every ACK dying is vanishingly rare, but a
+# fault-plane link outage makes it certain, and rejecting that ACK
+# deadlocks the connection (the retransmitted low segment is below the
+# receiver's rcv_nxt forever). Found by the PR-4 outage tests.
 # Time-valued fields with i64 SEMANTICS (RTT estimator state, retransmit
 # deadline, RTT-sample stamp — values up to rto_max·backoff / absolute sim
 # time). Stored as order-preserving i32 (hi, lo) plane pairs (core/events.py
@@ -402,6 +410,11 @@ def tcp_flush(st, ctx, mask, sock, now):
     adv = nxt != nxt0
     d = dict(tcp)
     d["snd_nxt"] = set_col(d["snd_nxt"], sock, nxt, mask & adv)
+    smax0 = g("snd_max")
+    d["snd_max"] = set_col(
+        d["snd_max"], sock, jnp.where((nxt - smax0) > 0, nxt, smax0),
+        mask & adv,
+    )
     d["ts_act"] = set_col(d["ts_act"], sock, True, mask & ts_first)
     d["ts_seq"] = set_col(d["ts_seq"], sock, ts_seq, mask & ts_first)
     tshi, tslo = tb_split(ts_time)
@@ -469,6 +482,7 @@ def _init_conn(r: Sock, ctx, mask, peer_host, peer_sock, state, rcv_nxt):
     r.s("peer_sock", peer_sock, mask)
     r.s("snd_una", 0, mask)
     r.s("snd_nxt", 0, mask)
+    r.s("snd_max", 0, mask)
     r.s("rcv_nxt", rcv_nxt, mask)
     r.s("app_end", 1, mask)
     r.s("fin_pend", 0, mask)
@@ -616,10 +630,13 @@ def tcp_rx(st, ctx, mask, p, now):
     r.s("peer_sock", ss, v & learn_peer)
     r.s("peer_wnd", jnp.maximum(wnd, 1), v & is_ack)
 
-    # ---- ACK processing
+    # ---- ACK processing. Acceptance tests against snd_max (highest ever
+    # sent), NOT the possibly-rewound snd_nxt — see the snd_max note at
+    # _FIELDS_I32 (outage-recovery deadlock otherwise).
     a = v & is_ack
     snd_una, snd_nxt = r.g("snd_una"), r.g("snd_nxt")
-    new_ack = a & ((ackno - snd_una) > 0) & ((ackno - snd_nxt) <= 0)
+    snd_max = r.g("snd_max")
+    new_ack = a & ((ackno - snd_una) > 0) & ((ackno - snd_max) <= 0)
     # RTT sample (RFC6298, integer ns; err>>3 is floor division by 8).
     ts_ok = new_ack & r.g("ts_act") & ((ackno - r.g("ts_seq")) >= 0)
     rtt = jnp.maximum(now - r.g("ts_time"), 1)
@@ -639,12 +656,15 @@ def tcp_rx(st, ctx, mask, p, now):
     )
     r.s("cwnd", jnp.minimum(cwnd + grow, CWND_MAX), new_ack)
     r.s("snd_una", ackno, new_ack)
+    # An ACK beyond the rewound snd_nxt pulls it forward: those bytes were
+    # sent (snd_max proves it) and are now acked — never resend them.
+    r.s("snd_nxt", ackno, new_ack & ((ackno - snd_nxt) > 0))
     r.s("dupacks", 0, new_ack)
     # Retire message boundaries the peer has fully acked.
     keep = r.g("mq_valid") & ((r.g("mq_end") - ackno[None, :]) > 0)
     r.s("mq_valid", keep, new_ack)
     # Restart (or clear) the retransmit deadline.
-    outstanding = (snd_nxt - ackno) > 0
+    outstanding = (snd_max - ackno) > 0
     r.s("rtx_t", jnp.where(outstanding, now + r.g("rto"), 0), new_ack)
 
     # State transitions driven by this ACK.
@@ -757,7 +777,7 @@ def on_tcp_timer(st, ctx, ev):
     future = live & (now < deadline)
     r.s("timer_armed", True, future)
     fire = live & ~future
-    outstanding = (r.g("snd_nxt") - r.g("snd_una")) > 0
+    outstanding = (r.g("snd_max") - r.g("snd_una")) > 0
     rto_fire = fire & outstanding & _state_in(r.g("st"), _SENDABLE)
     flight = r.g("snd_nxt") - r.g("snd_una")
     r.s("ssthresh", jnp.maximum(flight // 2, 2 * pr.mss), rto_fire)

@@ -67,6 +67,8 @@ METRIC_SPECS: dict[str, tuple[str, str]] = {
     "fires_timer": (COUNTER, "rounds where the K_TCP_TIMER pass fired"),
     "fires_txr": (COUNTER, "rounds where the K_TX_RESUME pass fired"),
     "fires_app": (COUNTER, "rounds where the K_APP pass fired"),
+    "link_down_pkts": (COUNTER, "packets dropped: link outage window (fault plane)"),
+    "host_restarts": (COUNTER, "host restart resets applied (fault plane churn)"),
 }
 
 # JSONL record types every consumer recognises (docs/OBSERVABILITY.md).
@@ -84,7 +86,9 @@ RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
 # be discarded, with the human-readable reason. Heartbeat records and the
 # CLI's final JSON group these under one structured ``drops`` block (and
 # tools/heartbeat_report.py prints them as a drop-reason table) instead of
-# nine flat counters scattered through ``delta``.
+# eleven flat counters scattered through ``delta``. The fault plane's
+# discards live here too — churn experiments must account for every
+# fault-induced loss through the same table.
 DROP_SPECS: dict[str, str] = {
     "ev_overflow": "event buffer full",
     "ob_overflow": "outbox full",
@@ -93,7 +97,9 @@ DROP_SPECS: dict[str, str] = {
     "nic_rx_drops": "NIC downlink queue full",
     "nic_aqm_drops": "RED early drop (uplink)",
     "tcp_ooo_drops": "out-of-order segment (GBN receiver)",
-    "down_pkts": "destination host stopped",
+    "down_events": "event at a dead host (churn)",
+    "down_pkts": "destination host dead at arrival (churn)",
+    "link_down_pkts": "link outage window (fault plane)",
     "pkts_lost": "path loss draw",
 }
 DROP_FIELDS = tuple(DROP_SPECS)
@@ -107,6 +113,7 @@ DROP_FIELDS = tuple(DROP_SPECS)
 RING_COUNTERS = (
     "events", "rounds", "pkts_sent", "pkts_delivered", "pkts_lost",
     "ev_overflow", "ob_overflow", "x2x_overflow", "down_events", "down_pkts",
+    "link_down_pkts", "host_restarts",
 )
 RING_GAUGES = (
     "evbuf_fill",       # max pending events on any host at window end

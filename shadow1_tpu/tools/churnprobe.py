@@ -1,0 +1,186 @@
+"""churnprobe — fault-plane scenario runner and cross-engine verdict.
+
+    python -m shadow1_tpu.tools.churnprobe CONFIG [options]
+
+Runs a faulted experiment (a config with a ``faults:`` section — e.g.
+``configs/churn_filexfer.yaml``) on multiple engines with the determinism
+flight recorder on, and verifies the two properties a churn experiment
+must have before its results mean anything:
+
+1. **digest-stream parity** — the per-window state digests
+   (core/digest.py) are bit-identical across every requested side
+   (default: cpu, tpu, and sharded over all local devices when >1). The
+   fault plane is only trustworthy if killing hosts and links perturbs
+   every engine identically; the digest stream is the per-window proof.
+2. **drop accounting** — every routed packet is accounted for:
+   ``pkts_sent == pkts_delivered + pkts_lost + link_down_pkts + down_pkts
+   + ev_overflow_deliveries + x2x_overflow`` (the delivery-side overflow
+   share is folded in via the counters). No silent event loss under churn.
+
+Prints one JSON verdict to stdout. Exit codes: 0 = all sides agree and
+accounting closes, 3 = divergence or accounting hole, 2 = usage error.
+
+Side specs: ``cpu``, ``tpu``, ``sharded[:D]`` (same grammar as
+tools/paritytrace.py; use paritytrace to BISECT a divergence this probe
+reports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from shadow1_tpu.core.digest import DIGEST_FIELDS
+
+# Counters every side must agree on (includes the fault-plane set).
+VERDICT_KEYS = (
+    "events", "pkts_sent", "pkts_delivered", "pkts_lost", "link_down_pkts",
+    "down_pkts", "down_events", "host_restarts", "tcp_rto", "tcp_fast_rtx",
+    "tcp_ooo_drops", "ev_overflow", "ob_overflow",
+)
+
+
+def _digest_rows_cpu(exp, params, n_windows):
+    from shadow1_tpu.cpu_engine import CpuEngine
+
+    eng = CpuEngine(exp, params)
+    metrics = eng.run(n_windows=n_windows)
+    rows = {r["window"]: tuple(r[f] for f in DIGEST_FIELDS)
+            for r in eng.digest_rows}
+    return metrics, rows
+
+
+def _digest_rows_batch(engine, n_windows, chunk):
+    """Chunked run draining the telemetry ring each boundary — the full
+    per-window digest stream regardless of run length."""
+    from shadow1_tpu.ckpt import run_chunked
+    from shadow1_tpu.telemetry.ring import drain_ring
+
+    rows: dict[int, tuple] = {}
+    start = [0]
+
+    def on_chunk(st, _done):
+        for r in drain_ring(st, engine.window, start=start[0]):
+            if r["type"] == "ring":
+                rows[r["window"]] = tuple(r[f] for f in DIGEST_FIELDS)
+        start[0] = int(st.metrics.windows)
+
+    st = run_chunked(engine, n_windows=n_windows, chunk=chunk,
+                     on_chunk=on_chunk)
+    return type(engine).metrics_dict(st), rows
+
+
+def run_side(spec, exp, params, n_windows, chunk):
+    params = dataclasses.replace(params, state_digest=1,
+                                 metrics_ring=max(params.metrics_ring, chunk))
+    if spec == "cpu":
+        return _digest_rows_cpu(exp, params, n_windows)
+    if spec == "tpu":
+        from shadow1_tpu.core.engine import Engine
+
+        return _digest_rows_batch(Engine(exp, params), n_windows, chunk)
+    if spec.startswith("sharded"):
+        import jax
+
+        from shadow1_tpu.shard.engine import ShardedEngine
+
+        _, _, d = spec.partition(":")
+        devs = jax.devices()[: int(d)] if d else None
+        return _digest_rows_batch(ShardedEngine(exp, params, devices=devs),
+                                  n_windows, chunk)
+    raise SystemExit(f"unknown side spec {spec!r}")
+
+
+def accounting(m: dict) -> dict:
+    """The churn drop-accounting identity: where every sent packet went.
+    ``ev_overflow`` counts event-buffer drops from both local pushes and
+    deliveries; only the delivery share belongs here, so the identity is
+    checked as sent ≤ explained ≤ sent + ev_overflow (exact when
+    ev_overflow == 0 — overflow-free runs are the parity contract)."""
+    explained = (m["pkts_delivered"] + m["pkts_lost"] + m["link_down_pkts"]
+                 + m["down_pkts"] + m.get("x2x_overflow", 0))
+    lo, hi = explained, explained + m["ev_overflow"]
+    return {
+        "pkts_sent": m["pkts_sent"],
+        "explained": explained,
+        "ev_overflow": m["ev_overflow"],
+        "closes": lo <= m["pkts_sent"] <= hi,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="churnprobe", description=__doc__)
+    ap.add_argument("config")
+    ap.add_argument("--sides", default=None,
+                    help="comma list of cpu|tpu|sharded[:D] "
+                         "(default: cpu,tpu[,sharded when >1 device])")
+    ap.add_argument("--windows", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    import shadow1_tpu  # noqa: F401 (x64 first)
+    from shadow1_tpu.config.experiment import load_experiment
+
+    exp, params, _ = load_experiment(args.config)
+    if exp.faults is None:
+        print(json.dumps({"error": "config has no faults: section — "
+                          "churnprobe verifies the fault plane"}))
+        return 2
+    n_windows = args.windows
+    if n_windows is None:
+        n_windows = int(-(-exp.end_time // exp.window))
+    sides = args.sides.split(",") if args.sides else None
+    if sides is None:
+        import jax
+
+        sides = ["cpu", "tpu"]
+        if len(jax.devices()) > 1 and exp.n_hosts % len(jax.devices()) == 0:
+            sides.append(f"sharded:{len(jax.devices())}")
+
+    results = {}
+    for s in sides:
+        metrics, rows = run_side(s, exp, params, n_windows, args.chunk)
+        results[s] = (dict(metrics), rows)
+
+    ref_spec = sides[0]
+    ref_m, ref_rows = results[ref_spec]
+    verdict: dict = {
+        "config": args.config,
+        "windows": n_windows,
+        "sides": sides,
+        "counters": {s: {k: int(m.get(k, 0)) for k in VERDICT_KEYS}
+                     for s, (m, _r) in results.items()},
+        "accounting": {s: accounting(m) for s, (m, _r) in results.items()},
+    }
+    ok = all(v["closes"] for v in verdict["accounting"].values())
+    first_div = None
+    for s in sides[1:]:
+        m, rows = results[s]
+        for k in VERDICT_KEYS:
+            if int(m.get(k, 0)) != int(ref_m.get(k, 0)):
+                ok = False
+        common = sorted(set(ref_rows) & set(rows))
+        verdict.setdefault("digest_windows_compared", {})[s] = len(common)
+        for w in common:
+            if rows[w] != ref_rows[w]:
+                subs = [DIGEST_FIELDS[i][3:] for i in range(len(DIGEST_FIELDS))
+                        if rows[w][i] != ref_rows[w][i]]
+                first_div = {"window": w, "side": s, "subsystems": subs}
+                ok = False
+                break
+        if first_div:
+            break
+    if first_div:
+        verdict["first_divergence"] = first_div
+        verdict["hint"] = (f"bisect with: python -m shadow1_tpu.tools."
+                           f"paritytrace {args.config} {ref_spec} "
+                           f"{first_div['side']}")
+    verdict["ok"] = ok
+    print(json.dumps(verdict))
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,7 +83,7 @@ def main() -> int:
     while heap and heap[0][0] < end:
         time, tb, _g, host, kind, p = heapq.heappop(heap)
         eng.pending[host] -= 1
-        if eng.has_stop and time >= eng.stop_time[host]:
+        if eng.has_stop and eng._down_at(host, time):
             continue
         w = time // W
         if kind == K_PKT and rx_batch:

@@ -26,3 +26,10 @@ jax.config.update("jax_platforms", "cpu")
 # compiles across test runs cuts suite time substantially.
 jax.config.update("jax_compilation_cache_dir", "/tmp/shadow1_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# CLI subprocess tests (supervise/trace/auto-caps) inherit os.environ: give
+# their children the same persistent cache via the env-var config route, so
+# every spawned `python -m shadow1_tpu` reuses compiles instead of paying
+# the multi-second engine trace per process (tier-1 wall budget, PR 4).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/shadow1_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")

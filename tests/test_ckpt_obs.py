@@ -8,6 +8,8 @@ reference's determinism diff-test (SURVEY §4).
 import io
 import json
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -86,6 +88,10 @@ def test_heartbeat_stream():
     assert all(r["type"] == "heartbeat" for r in lines)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): subsumed in the fast tier
+# by tests/test_fault.py::test_supervise_survives_crash_and_corrupt_checkpoint
+# (same crash-injection recipe PLUS a corrupted leftover checkpoint);
+# ./ci.sh all still runs this plain-crash variant.
 def test_cli_supervise_survives_device_fault(tmp_path):
     """End-to-end --ckpt supervision: the child process is killed hard (the
     fault-injection hook dies like a wedged TPU worker) after its first

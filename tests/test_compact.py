@@ -118,6 +118,8 @@ def test_net_compact_matches_plain_engine():
     jax.tree.map(cmp, st_a, st_b)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): heaviest of its family;
+# a faster sibling keeps the coverage in the fast tier; ./ci.sh all runs it.
 def test_tor_compact_parity():
     """Tor: the widest model state (relay tables, circuit maps, cell
     streams) through the gather/scatter round-trip, vs the plain engine."""
@@ -135,6 +137,8 @@ def test_tor_compact_parity():
     jax.tree.map(cmp, st_a, st_b)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): heaviest of its family;
+# a faster sibling keeps the coverage in the fast tier; ./ci.sh all runs it.
 def test_sharded_compact_parity():
     """Compaction inside shard_map: each shard compacts its local block;
     results must equal the plain single-device engine. Sparse TCP traffic

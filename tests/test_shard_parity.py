@@ -152,6 +152,8 @@ def test_dryrun_multichip_gate():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): heaviest of its family;
+# a faster sibling keeps the coverage in the fast tier; ./ci.sh all runs it.
 def test_tor_sharded_parity():
     """The flagship multi-chip workload (rung 4 is sharded Tor): clients,
     weighted relays and dirauths spread across all 8 shards; every semantic
@@ -190,6 +192,8 @@ def test_filexfer_sharded_parity():
     assert_same(m1, s1, m8, s8, summary_keys=("rx_bytes", "flows_done", "done_time"))
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): RED parity is covered by
+# test_fidelity.test_red_aqm_parity; the sharded combination runs in all.
 def test_filexfer_red_aqm_sharded_parity():
     """RED AQM under sharding: the per-host aqm columns (thresholds, coin
     counters) ride the mesh like every other [H] tensor; drops must land on

@@ -7,6 +7,7 @@ same completion times — including under loss.
 """
 
 import numpy as np
+import pytest
 
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
@@ -71,6 +72,8 @@ def test_tor_circuits_parity():
     assert_parity(cm, cs, tm, ts, keys=TOR_KEYS)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 4): heaviest of its family;
+# a faster sibling keeps the coverage in the fast tier; ./ci.sh all runs it.
 def test_tor_under_loss_parity():
     exp = tor_exp(seed=5, loss=0.01, end=60 * SEC)
     cm, cs, tm, ts = run_both(exp, PARAMS)
