@@ -4,13 +4,18 @@
 #   ./ci.sh            fast tier: full suite minus the slow mid-scale tier
 #   ./ci.sh all        everything, including 512–1024-host parity
 #   ./ci.sh smoke      config + events + ckpt/obs/telemetry + tune + digest
-#                      fast paths (tgen-based tune tests stay in fast/all),
-#                      plus a tiny tpu-vs-cpu paritytrace bisect on the
-#                      rung-1 config: inject a window-8 corruption, assert
-#                      the flight recorder localizes it to exactly window 8;
-#                      plus the fault-plane smokes: a shortened churn-
-#                      scenario cpu-vs-tpu digest parity run (churnprobe)
-#                      and corrupt-checkpoint rejection (integrity digest)
+#                      + txn fast paths (tgen-based tune tests stay in
+#                      fast/all), plus a tiny tpu-vs-cpu paritytrace bisect
+#                      on the rung-1 config: inject a window-8 corruption,
+#                      assert the flight recorder localizes it to exactly
+#                      window 8; plus the fault-plane smokes: a shortened
+#                      churn-scenario cpu-vs-tpu digest parity run
+#                      (churnprobe) and corrupt-checkpoint rejection
+#                      (integrity digest); plus the overflow-policy smokes:
+#                      an under-capped run under --on-overflow retry must
+#                      bit-match its big-cap twin's digest stream, and
+#                      --on-overflow halt must exit 4 with paste-ready
+#                      cap advice (CapacityExceededError)
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -21,7 +26,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -47,6 +52,65 @@ print("churnprobe: 40-window digest parity ok;",
       "restarts:", d["counters"]["tpu"]["host_restarts"],
       "down_pkts:", d["counters"]["tpu"]["down_pkts"])
 '
+    echo "== overflow-retry parity smoke (txn plane) =="
+    # A deliberately under-capped PHOLD run under --on-overflow retry must
+    # (a) actually retry, (b) produce a digest stream bit-identical to the
+    # same config run straight at the final (grown) caps; plus one halt
+    # exit-code check (CapacityExceededError → exit 4, advice on stderr).
+    of_cfg=$(mktemp /tmp/shadow1_of_XXXX.yaml)
+    cat > "$of_cfg" <<'YAML'
+general: {seed: 5, stop_time: 40 ms}
+engine: {scheduler: tpu, ev_cap: 8}
+network: {single_vertex: {latency: 1 ms}}
+hosts:
+  - {name: h, count: 8}
+app:
+  model: phold
+  params: {mean_delay_ns: 2000000.0, init_events: 6}
+YAML
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$of_cfg" <<'EOF'
+import sys
+import shadow1_tpu
+from shadow1_tpu.ckpt import run_chunked
+from shadow1_tpu.config.experiment import load_experiment
+from shadow1_tpu.consts import EngineParams
+from shadow1_tpu.core.digest import DIGEST_FIELDS
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.telemetry.ring import drain_ring
+from shadow1_tpu.txn import OverflowGuard
+import dataclasses
+
+exp, params, _ = load_experiment(sys.argv[1])
+params = dataclasses.replace(params, metrics_ring=10, state_digest=1)
+
+def stream(eng, guard=None):
+    rows, start = {}, [0]
+    def on_chunk(st, _d):
+        for r in drain_ring(st, eng.window, start=start[0]):
+            if r["type"] == "ring":
+                rows[r["window"]] = tuple(r[f] for f in DIGEST_FIELDS)
+        start[0] = int(st.metrics.windows)
+    st = run_chunked(eng, n_windows=40, chunk=10, guard=guard,
+                     on_chunk=on_chunk)
+    return rows, st
+
+eng = Engine(exp, params)
+guard = OverflowGuard(eng, make_engine=lambda p: Engine(exp, p), mode="retry")
+rows_retry, st = stream(eng, guard)
+assert guard.chunk_retries >= 1, "under-capped config did not retry"
+assert int(st.metrics.ev_overflow) == 0, "committed stream must be clean"
+big = guard.final_caps["ev_cap"]
+rows_big, _ = stream(Engine(exp, dataclasses.replace(params, ev_cap=big)))
+assert rows_retry == rows_big, "retry digest stream != big-cap twin"
+print(f"overflow retry: {guard.chunk_retries} chunk(s) replayed, "
+      f"ev_cap 8 -> {big}, 40-window digest parity with the big-cap twin")
+EOF
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu "$of_cfg" \
+        --on-overflow halt >/dev/null 2>/tmp/_of_halt.log && rc=0 || rc=$?
+    [ "$rc" -eq 4 ] || { echo "halt: expected CapacityExceededError exit 4, got $rc" >&2; exit 1; }
+    grep -q "Paste-ready fix" /tmp/_of_halt.log || { echo "halt: advice missing" >&2; exit 1; }
+    echo "halt: exit 4 with paste-ready cap advice ok"
+    rm -f "$of_cfg" /tmp/_of_halt.log
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
 import tempfile, os

@@ -262,7 +262,8 @@ def snapshot_caps(template, path: str) -> tuple[int, int] | None:
 
 
 def run_chunked(engine, st=None, n_windows: int | None = None,
-                chunk: int = 0, on_chunk=None, profiler=None, retune=None):
+                chunk: int = 0, on_chunk=None, profiler=None, retune=None,
+                guard=None, selfcheck: bool = False):
     """Run in fixed-size window chunks, invoking ``on_chunk(st, done)`` after
     each (for checkpoints/heartbeats). One compiled program is reused for
     every full chunk. Returns the final state.
@@ -274,27 +275,60 @@ def run_chunked(engine, st=None, n_windows: int | None = None,
     hook (tune/autocap.CapController): it may hand back a DIFFERENT engine
     (re-jitted at new static capacities) with the state migrated to match.
     Called after ``on_chunk`` so heartbeats/checkpoints see the state that
-    actually ran the chunk; never called after the final chunk."""
+    actually ran the chunk; never called after the final chunk.
+
+    ``guard`` (txn.OverflowGuard — CLI ``--on-overflow retry|halt``) makes
+    chunk execution TRANSACTIONAL: the chunk-start state is kept as the
+    rollback point, and the guard's commit either accepts the chunk (no
+    fresh overflow), discards it and replays at grown caps, or raises a
+    structured CapacityExceededError. Commit runs BEFORE ``on_chunk``, so
+    heartbeats and checkpoints only ever see committed (overflow-free)
+    states — a checkpoint can never capture a tainted chunk. Without a
+    guard (the default ``drop`` policy) no state is retained and no extra
+    host sync is paid.
+
+    ``selfcheck`` (CLI ``--selfcheck``) verifies the drop-accounting
+    identity on every committed chunk boundary (txn.SelfCheckError on
+    violation) — churnprobe's probe-only invariant, guarding every run."""
     from shadow1_tpu.telemetry import PH_INIT, PH_RUN_CHUNK, maybe_span
 
     if st is None:
         with maybe_span(profiler, PH_INIT):
             st = engine.init_state()
+    if guard is not None:
+        guard.bind(engine, st)
     total = n_windows if n_windows is not None else engine.n_windows
     if chunk <= 0:
         chunk = total
     done = 0
     while done < total:
         step = min(chunk, total - done)
+        # Rollback point: jax states are immutable and run() never donates,
+        # so holding the reference is free until the commit drops it.
+        st0 = st if guard is not None else None
         with maybe_span(profiler, PH_RUN_CHUNK, windows=step, done=done):
-            st = engine.run(st, n_windows=step)
+            # Under a guard the sharded engine's eager x2x safety net
+            # stands down (guard.run_guarded passes check_x2x=False) — the
+            # commit below owns the overflow response.
+            st = (guard.run_guarded(engine, st, step) if guard is not None
+                  else engine.run(st, n_windows=step))
             if profiler is not None:
                 # Only when tracing: make the span cover execution, not just
                 # async dispatch. Chunk boundary — never inside a window.
                 jax.block_until_ready(st)
+        if guard is not None:
+            engine, st = guard.commit(engine, st0, st, done, step)
         done += step
+        if selfcheck:
+            from shadow1_tpu.txn import check_boundary_identity
+
+            check_boundary_identity(
+                type(engine).metrics_dict(st),
+                where=f"chunk boundary, window {int(st.metrics.windows)}")
         if on_chunk is not None:
             on_chunk(st, done)
         if retune is not None and done < total:
             engine, st = retune(engine, st)
+            if guard is not None:
+                guard.engine = engine
     return st

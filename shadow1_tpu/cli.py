@@ -17,6 +17,10 @@ import time
 
 MAX_RESPAWNS = 8
 
+# Exit code for a CapacityExceededError halt (shadow1_tpu/txn.py) — kept in
+# sync by the import below; duplicated as a literal nowhere.
+from shadow1_tpu.txn import EXIT_CAPACITY  # noqa: E402 (jax-free module)
+
 
 def _config_fingerprint(config_path: str) -> str:
     """Identity of the experiment a --ckpt snapshot belongs to. Snapshot
@@ -96,6 +100,17 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
         cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
                "--supervised-child"]
         rc = subprocess.run(cmd).returncode  # stdio inherited: heartbeats flow
+        if rc == EXIT_CAPACITY:
+            # Capacity halt (--on-overflow halt → CapacityExceededError):
+            # a deterministic config condition, not a device fault — a
+            # respawn would replay the identical overflow and burn the
+            # budget. The child already printed the structured advice.
+            print(f"[supervise] child halted on a capacity policy "
+                  f"(rc={rc}, CapacityExceededError) — deterministic "
+                  f"config condition; not respawning. Apply the engine: "
+                  f"cap advice above, or rerun with --on-overflow retry.",
+                  file=sys.stderr, flush=True)
+            return rc
         if rc == 0:
             # A finished run's snapshot must not silently resume a later
             # invocation of the same command into a no-op.
@@ -206,6 +221,22 @@ def main(argv=None) -> int:
                          "or as per-window 'digest' JSONL records on stderr "
                          "(cpu oracle). off (default) traces zero digest "
                          "ops. Compare streams with tools/paritytrace.py")
+    ap.add_argument("--on-overflow", choices=["drop", "retry", "halt"],
+                    default=None, metavar="drop|retry|halt",
+                    help="overflow policy at chunk boundaries "
+                         "(shadow1_tpu/txn.py; overrides engine.on_overflow). "
+                         "drop (default) = counted-but-lossy; retry = "
+                         "TRANSACTIONAL chunks: discard the tainted chunk, "
+                         "grow the offending cap one ladder step (bit-exact "
+                         "migration + re-jit) and replay it — the digest "
+                         "stream bit-matches a straight run at the final "
+                         "caps; halt = raise CapacityExceededError with "
+                         "paste-ready cap advice (exit code 4)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="verify the drop-accounting identity (every sent "
+                         "packet reaches exactly one counted fate) at every "
+                         "chunk/window boundary; violation = structured "
+                         "SelfCheckError naming the non-closing counters")
     ap.add_argument("--faults", choices=["on", "off"], default="on",
                     metavar="on|off",
                     help="fault plane (config `faults:` section — host "
@@ -246,15 +277,25 @@ def main(argv=None) -> int:
 
         params = dataclasses.replace(
             params, metrics_ring=args.heartbeat or 64)
+    if args.on_overflow is not None:
+        import dataclasses
+
+        params = dataclasses.replace(params, on_overflow=args.on_overflow)
+    if args.selfcheck:
+        import dataclasses
+
+        params = dataclasses.replace(params, selfcheck=1)
     auto_caps = bool(args.auto_caps or params.auto_caps)
     if engine_kind == "cpu" and (args.save_state or args.resume
                                  or args.heartbeat or args.tracker
                                  or args.profile or args.ckpt
                                  or args.trace or args.metrics_ring
-                                 or args.auto_caps):
+                                 or args.auto_caps
+                                 or args.on_overflow == "retry"):
         ap.error("--save-state/--resume/--heartbeat/--tracker/--profile/"
-                 "--ckpt/--trace/--metrics-ring/--auto-caps require a "
-                 "batched engine (tpu or sharded)")
+                 "--ckpt/--trace/--metrics-ring/--auto-caps/"
+                 "--on-overflow retry require a batched engine "
+                 "(tpu or sharded)")
     if args.ckpt and args.resume and args.windows is not None:
         # Under supervision --windows is the TOTAL for the whole run; under
         # --resume it means N MORE windows. Combining all three makes a
@@ -284,6 +325,25 @@ def main(argv=None) -> int:
     metrics0: dict[str, int] = {}
     resume_path = None
     controller = None
+    guard = None
+
+    from shadow1_tpu.txn import CapacityExceededError
+
+    def _capacity_exit(e: CapacityExceededError) -> int:
+        """Structured halt: full advice on stderr, one parseable JSON error
+        record on stdout, the dedicated exit code the supervisor classifies
+        as deterministic (no respawn)."""
+        print(f"CapacityExceededError: {e}", file=sys.stderr, flush=True)
+        print(json.dumps({
+            "error": "capacity_exceeded",
+            "knob": e.knob,
+            "counter": e.counter,
+            "cap": e.cap,
+            "overflow": e.overflow,
+            "windows": list(e.window_range),
+            "recommended": e.recommended,
+        }))
+        return EXIT_CAPACITY
 
     if engine_kind == "cpu":
         from shadow1_tpu.cpu_engine import CpuEngine
@@ -296,8 +356,17 @@ def main(argv=None) -> int:
             log.warning("engine.auto_caps ignored: the cpu oracle runs "
                         "eagerly per event, there is no chunked window loop "
                         "to retune")
+        if params.on_overflow == "retry":
+            # Same precedent: config-level retry stays inert on the oracle
+            # (it cannot re-run a window); the explicit flag errors above.
+            log.warning("engine.on_overflow=retry ignored: the eager cpu "
+                        "oracle cannot replay a window; halt and "
+                        "--selfcheck apply as boundary checks")
         eng = CpuEngine(exp, params)
-        metrics = eng.run(n_windows=args.windows)
+        try:
+            metrics = eng.run(n_windows=args.windows)
+        except CapacityExceededError as e:
+            return _capacity_exit(e)
         summary = eng.summary()
         n_windows = args.windows if args.windows is not None else eng.n_windows
         if params.state_digest:
@@ -330,13 +399,14 @@ def main(argv=None) -> int:
             params0, eng0 = params, eng
             try:
                 template = eng.init_state()
-                if auto_caps:
+                if auto_caps or params.on_overflow == "retry":
                     # An --auto-caps run checkpoints at whatever cap it had
-                    # grown to; a host may hold more events than the
-                    # config's static cap, so the respawned engine must
-                    # START at the snapshot's caps (the controller
-                    # re-shrinks later if the occupancy allows) — otherwise
-                    # every respawn would die in the
+                    # grown to — and so does an --on-overflow retry run
+                    # (retry-driven grows stick); a host may hold more
+                    # events than the config's static cap, so the respawned
+                    # engine must START at the snapshot's caps (the
+                    # controller re-shrinks later if the occupancy allows)
+                    # — otherwise every respawn would die in the
                     # shrink-refuses-to-drop-events check.
                     snap = snapshot_caps(template, resume_path)
                     if snap and snap != (params.ev_cap, params.outbox_cap):
@@ -386,34 +456,51 @@ def main(argv=None) -> int:
 
             controller = CapController(eng, lambda p: Eng(exp, p),
                                        log=log.info, initial_state=st)
-        with prof:
-            # phases covers --profile too: its phases.trace.json must carry
-            # real spans, so any profiled run routes through the
-            # instrumented chunk runner. --auto-caps needs the chunked path
-            # too: resizes happen at chunk boundaries.
-            if (args.heartbeat or args.ckpt or ring_w or phases is not None
-                    or controller is not None):
-                from shadow1_tpu.obs import run_with_heartbeat
+        if params.on_overflow in ("retry", "halt"):
+            from shadow1_tpu.txn import OverflowGuard
 
-                st, _hb = run_with_heartbeat(
-                    eng, st, n_windows=args.windows,
-                    # Ring-only runs chunk at the ring depth so the drain
-                    # keeps up with the overwrites: gap-free per-window
-                    # records without --heartbeat.
-                    every_windows=args.heartbeat or (ring_w or None),
-                    # --ckpt/--trace without --heartbeat chunk the run but
-                    # emit no heartbeat lines; ring records always flow
-                    # when the ring is on.
-                    stream=None if (args.heartbeat or ring_w) else False,
-                    ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
-                    profiler=phases,
-                    emit_heartbeat=bool(args.heartbeat),
-                    emit_ring=bool(ring_w),
-                    controller=controller,
-                )
-            else:
-                st = eng.run(st, n_windows=args.windows)
-            jax.block_until_ready(st)
+            # Shares the controller's engine cache when --auto-caps is on,
+            # and reports retry-driven grows to its lossless floor — the
+            # two planes never double-grow or oscillate (tune/autocap.py).
+            guard = OverflowGuard(eng, make_engine=lambda p: Eng(exp, p),
+                                  mode=params.on_overflow,
+                                  controller=controller, log=log.info)
+        try:
+            with prof:
+                # phases covers --profile too: its phases.trace.json must
+                # carry real spans, so any profiled run routes through the
+                # instrumented chunk runner. --auto-caps needs the chunked
+                # path too (resizes happen at chunk boundaries), as do the
+                # overflow policy and --selfcheck (both are chunk-boundary
+                # checks).
+                if (args.heartbeat or args.ckpt or ring_w
+                        or phases is not None or controller is not None
+                        or guard is not None or params.selfcheck):
+                    from shadow1_tpu.obs import run_with_heartbeat
+
+                    st, _hb = run_with_heartbeat(
+                        eng, st, n_windows=args.windows,
+                        # Ring-only runs chunk at the ring depth so the
+                        # drain keeps up with the overwrites: gap-free
+                        # per-window records without --heartbeat.
+                        every_windows=args.heartbeat or (ring_w or None),
+                        # --ckpt/--trace without --heartbeat chunk the run
+                        # but emit no heartbeat lines; ring records always
+                        # flow when the ring is on.
+                        stream=None if (args.heartbeat or ring_w) else False,
+                        ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
+                        profiler=phases,
+                        emit_heartbeat=bool(args.heartbeat),
+                        emit_ring=bool(ring_w),
+                        controller=controller,
+                        guard=guard,
+                        selfcheck=bool(params.selfcheck),
+                    )
+                else:
+                    st = eng.run(st, n_windows=args.windows)
+                jax.block_until_ready(st)
+        except CapacityExceededError as e:
+            return _capacity_exit(e)
         if phases is not None:
             if args.trace:
                 phases.write(args.trace)
@@ -436,6 +523,11 @@ def main(argv=None) -> int:
 
     wall = time.perf_counter() - t0
     sim_s = n_windows * exp.window / 1e9
+    if guard is not None:
+        # The retry plane's host-side counters ride the same metrics
+        # namespace (telemetry.registry HOST_FIELDS).
+        metrics = {**metrics, "chunk_retries": guard.chunk_retries,
+                   "retry_windows_rerun": guard.retry_windows_rerun}
     # Rates cover THIS invocation: under --resume, cumulative checkpointed
     # metrics are baselined out.
     ev_run = metrics["events"] - metrics0.get("events", 0)
@@ -470,6 +562,11 @@ def main(argv=None) -> int:
                    ("down_events", "down_pkts", "link_down_pkts")}
     if restarts or any(fault_drops.values()):
         out["faults"] = {"host_restarts": restarts, **fault_drops}
+    if guard is not None:
+        # Run totals of the transactional plane: always present under a
+        # non-drop policy (chunk_retries == 0 is the explicit "no chunk
+        # was ever tainted" signal), with the per-retry audit log.
+        out["retries"] = {**guard.report(), "resizes": guard.resizes}
     if controller is not None:
         out["auto_caps"] = {
             "resizes": controller.resizes,

@@ -148,11 +148,29 @@ _APP_PARAMS: dict[str, dict[str, tuple]] = {
 }
 
 
+def _reject_unknown(section: str, have, allowed) -> None:
+    """Unknown-key rejection, fault/schedule.py-style, for every config
+    section: a typo like ``ev_capp:`` or ``stop_tme:`` must fail fast at
+    load instead of silently running the experiment on defaults."""
+    unknown = set(have) - set(allowed)
+    assert not unknown, (
+        f"unknown {section} keys: {sorted(map(str, unknown))} "
+        f"(allowed: {sorted(allowed)})"
+    )
+
+
+# Host-group entry schema (the per-group knobs _expand_hosts reads).
+_HOST_KEYS = ("name", "count", "vertex", "bandwidth_up", "bandwidth_down",
+              "stop_time", "cpu_per_event", "tx_queue_bytes",
+              "rx_queue_bytes", "aqm_min_bytes", "aqm_max_bytes", "aqm_pmax")
+
+
 def _expand_hosts(spec: list[dict]) -> list[HostGroup]:
     from shadow1_tpu.config.compiled import NO_STOP
 
     groups, start = [], 0
     for g in spec:
+        _reject_unknown(f"hosts[{g.get('name', start)}]", g, _HOST_KEYS)
         count = int(g.get("count", 1))
         groups.append(HostGroup(
             name=g["name"],
@@ -258,7 +276,11 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
     """YAML document → (CompiledExperiment, EngineParams, scheduler)."""
     import os
 
+    _reject_unknown("top-level config", doc,
+                    ("general", "engine", "network", "hosts", "app",
+                     "faults"))
     gen = doc.get("general", {})
+    _reject_unknown("general:", gen, ("seed", "stop_time"))
     seed = int(gen.get("seed", 1))
     end_time = parse_time_ns(gen.get("stop_time", "10 s"))
 
@@ -277,6 +299,10 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
 
     # -- network -----------------------------------------------------------
     net = doc.get("network", {})
+    _reject_unknown("network:", net, ("graphml", "single_vertex", "jitter"))
+    if "single_vertex" in net:
+        _reject_unknown("network.single_vertex:", net["single_vertex"],
+                        ("latency", "loss"))
     if "graphml" in net:
         path = net["graphml"]
         if not os.path.isabs(path):
@@ -322,6 +348,7 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
 
     # -- app ---------------------------------------------------------------
     appsec = doc.get("app", {"model": "phold"})
+    _reject_unknown("app:", appsec, ("model", "params", "defaults", "groups"))
     app = appsec["model"]
     model_cfg: dict[str, Any] = dict(appsec.get("params", {}))
     schema = _APP_PARAMS.get(app)

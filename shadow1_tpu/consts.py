@@ -159,6 +159,23 @@ class EngineParams:
     # = off: zero digest ops traced anywhere — the ring columns exist but
     # hold zeros. CLI --state-digest.
     state_digest: int = 0
+    # Overflow policy (shadow1_tpu/txn.py; CLI --on-overflow): what the
+    # chunk runner does when a chunk's fresh overflow deltas (ev_overflow /
+    # ob_overflow / sharded x2x_overflow) are non-zero at its boundary.
+    # "drop" (default) keeps today's counted-but-lossy behavior; "retry"
+    # discards the tainted chunk, grows the offending cap one ladder step
+    # (bit-exact state migration + re-jit) and replays the same chunk from
+    # the saved chunk-start state — the retried run's digest stream
+    # bit-matches a straight run at the final caps; "halt" raises a
+    # structured CapacityExceededError with paste-ready cap advice.
+    # Inert on the eager CPU oracle except "halt" (boundary check only).
+    on_overflow: str = "drop"
+    # In-run self-check (txn.check_boundary_identity; CLI --selfcheck):
+    # 1 = verify the drop-accounting identity (every sent packet reaches
+    # exactly one counted fate) at every chunk boundary (batched engines)
+    # / window boundary (cpu oracle); violation raises SelfCheckError
+    # naming the non-closing counters. 0 (default) = off.
+    selfcheck: int = 0
     # Pop-min result extraction: "sum" (masked-sum over the one-hot — the
     # round-4 default) or "gather" (index via min-over-iota, then
     # take_along_axis — the round-3 style on the round-4 layout). Bit-exact
@@ -192,6 +209,8 @@ class EngineParams:
         assert self.metrics_ring >= 0, self.metrics_ring
         assert self.state_digest in (0, 1), self.state_digest
         assert self.auto_caps >= 0, self.auto_caps
+        assert self.on_overflow in ("drop", "retry", "halt"), self.on_overflow
+        assert self.selfcheck in (0, 1), self.selfcheck
         assert self.pop_impl in ("xla", "pallas"), self.pop_impl
         assert self.push_impl in ("xla", "pallas"), self.push_impl
         # The fused pop kernel extracts via the one-hot masked sum only; a

@@ -125,12 +125,25 @@ class CpuEngine:
         # (tcp/nic/rng) are recomputed per boundary from live state. Rows
         # land in ``digest_rows`` as JSONL-ready REC_DIGEST dicts.
         self.digest_on = bool(self.params.state_digest)
+        # Overflow policy / self-check at window boundaries (txn.py): the
+        # oracle runs the SAME boundary checks as the chunked batch runner
+        # — "halt" raises the structured CapacityExceededError on fresh
+        # overflow, --selfcheck verifies the drop-accounting identity.
+        # "retry" is inert here like auto_caps: the eager oracle cannot
+        # re-run a window (the CLI warns; parity tests compare a batched
+        # retry run against the oracle run at the final caps instead).
+        self._halt_on_overflow = self.params.on_overflow == "halt"
+        self._selfcheck = bool(self.params.selfcheck)
+        self._of_seen = {"ev_overflow": 0, "ob_overflow": 0}
         self._ev_dg = 0
         self._ev_word: dict[int, int] = {}  # gseq → element word
         self._ob_dg: dict[int, int] = {}    # window → send-word sum
         self.digest_rows: list[dict] = []
         self.model = self._make_model()
         self.model.start()
+        # Seed-time overflow is baselined out, mirroring the batch guard's
+        # bind-after-init_state: only overflow DURING a window is fresh.
+        self._of_seen = {c: self.metrics[c] for c in self._of_seen}
         # Host restart schedule (fault plane): every finite window-quantized
         # up boundary, sorted — a restarted host's model columns restore to
         # the POST-start snapshot captured here (the oracle twin of the
@@ -307,6 +320,11 @@ class CpuEngine:
         self._apply_restarts_pending(upto)
         if self._next_boundary > upto:
             return
+        # Window index of the FIRST boundary crossed now — the window the
+        # boundary checks below attribute fresh overflow / violations to
+        # (no event ran between consecutive skipped boundaries, so the
+        # counters cannot have moved after the first one).
+        first_w = self._next_boundary // self.window - 1
         fill = int(self.pending.max()) if self.pending.size else 0
         if fill > self.metrics["ev_max_fill"]:
             self.metrics["ev_max_fill"] = fill
@@ -314,6 +332,7 @@ class CpuEngine:
             n_skipped = (upto - self._next_boundary) // self.window + 1
             self._next_boundary += n_skipped * self.window
             self._apply_restarts_pending(upto)
+            self._boundary_checks(first_w)
             return
         # One row per boundary window. The plane digests are static across
         # a multi-boundary stretch (no event ran in between, and no restart
@@ -338,6 +357,35 @@ class CpuEngine:
             self._next_boundary += self.window
             if self._apply_restarts_pending(b):
                 dg_tcp, dg_nic, dg_rng = self._digest_planes()
+        self._boundary_checks(first_w)
+
+    def _boundary_checks(self, w: int) -> None:
+        """The chunk-boundary guard's window-granularity twin (txn.py):
+        ``halt`` raises on fresh overflow since the last boundary;
+        ``--selfcheck`` verifies the drop-accounting identity. ``w`` is the
+        window the fresh activity belongs to."""
+        if self._halt_on_overflow:
+            from shadow1_tpu.tune.ladder import next_step, recommend_cap
+            from shadow1_tpu.txn import CapacityExceededError
+
+            for ctr, knob, gauge in (
+                    ("ev_overflow", "ev_cap", "ev_max_fill"),
+                    ("ob_overflow", "outbox_cap", "ob_max_fill")):
+                fresh = self.metrics[ctr] - self._of_seen[ctr]
+                self._of_seen[ctr] = self.metrics[ctr]
+                if fresh > 0:
+                    cap = getattr(self.params, knob)
+                    peak = int(self.metrics.get(gauge, 0))
+                    raise CapacityExceededError(
+                        knob=knob, counter=ctr, cap=cap, overflow=fresh,
+                        window_range=(max(w, 0), w + 1),
+                        recommended=max(next_step(cap),
+                                        recommend_cap(peak) if peak else 0))
+        if self._selfcheck:
+            from shadow1_tpu.txn import check_boundary_identity
+
+            check_boundary_identity(
+                self.metrics, where=f"window {w} boundary (cpu oracle)")
 
     def _digest_planes(self) -> tuple[int, int, int]:
         """(dg_tcp, dg_nic, dg_rng) of the CURRENT state — the oracle twins

@@ -50,11 +50,13 @@ class Heartbeat:
 
     def __init__(self, engine, stream=None, label: str = "heartbeat",
                  initial_state=None, profiler=None,
-                 emit_heartbeat: bool = True, emit_ring: bool = True):
+                 emit_heartbeat: bool = True, emit_ring: bool = True,
+                 guard=None):
         self.engine = engine
         self.stream = stream if stream is not None else sys.stderr
         self.label = label
         self.profiler = profiler
+        self.guard = guard  # txn.OverflowGuard — source of the retries block
         self.emit_heartbeat = emit_heartbeat
         self.emit_ring = emit_ring
         self.t_start = time.perf_counter()
@@ -109,6 +111,16 @@ class Heartbeat:
 
         drops = {f: delta.pop(f, 0) for f in DROP_FIELDS}
         rec["drops"] = {"total": sum(drops.values()), **drops}
+        # Overflow-retry plane (txn.OverflowGuard): host-side counters, so
+        # they never appear in engine deltas (normalize injects zeros —
+        # dropped here); when the guard has retried, a ``retries`` block
+        # carries the cumulative counters plus the live (grown) caps.
+        from shadow1_tpu.telemetry.registry import HOST_FIELDS
+
+        for f in HOST_FIELDS:
+            delta.pop(f, None)
+        if self.guard is not None and self.guard.chunk_retries:
+            rec["retries"] = self.guard.report()
         # Fault plane: when churn/outage activity happened this chunk, a
         # ``faults`` block surfaces it directly (restart resets plus the
         # fault-induced rows of the drops table) — docs/OBSERVABILITY.md.
@@ -168,7 +180,7 @@ class Heartbeat:
 def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                        stream=None, ckpt_path=None, ckpt_every_s=120.0,
                        profiler=None, emit_heartbeat=True, emit_ring=True,
-                       controller=None):
+                       controller=None, guard=None, selfcheck=False):
     """Run the engine emitting a heartbeat every ``every_windows`` windows.
 
     With ``ckpt_path``, engine state is snapshotted there at heartbeat
@@ -187,6 +199,14 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     adapt between chunks: the controller may swap in an engine re-jitted at
     new static capacities with the state migrated bit-exactly; subsequent
     heartbeats report the live engine's caps.
+
+    With ``guard`` (txn.OverflowGuard — CLI --on-overflow retry|halt),
+    chunks are transactional: overflowing chunks are discarded and replayed
+    at grown caps (or the run halts with a structured error), heartbeats
+    and checkpoints only ever see committed overflow-free states, and
+    heartbeat records carry a ``retries`` block once a retry happened.
+    ``selfcheck`` verifies the drop-accounting identity at every committed
+    boundary (txn.check_boundary_identity).
 
     Returns (final_state, heartbeat) — heartbeat.records holds the stream,
     heartbeat.ring_records the drained per-window telemetry rows.
@@ -208,16 +228,22 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     with maybe_span(profiler, PH_COMPILE):
         jax.block_until_ready(engine.run(st, n_windows=0))
     hb = Heartbeat(engine, stream=stream, initial_state=st, profiler=profiler,
-                   emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
+                   emit_heartbeat=emit_heartbeat, emit_ring=emit_ring,
+                   guard=guard)
     retune = None
     if controller is not None:
         def retune(eng_cur, s):
             eng_new, s = controller(eng_cur, s)
             hb.engine = eng_new  # heartbeat caps track the live engine
             return eng_new, s
+    if guard is not None:
+        # Retry-driven cap grows swap engines too — heartbeat fill blocks
+        # must report the caps of the engine that actually ran the chunk.
+        guard.on_engine_swap = lambda eng_new: setattr(hb, "engine", eng_new)
     if ckpt_path is None:
         st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                         on_chunk=hb, profiler=profiler, retune=retune)
+                         on_chunk=hb, profiler=profiler, retune=retune,
+                         guard=guard, selfcheck=selfcheck)
         return st, hb
 
     last_save = time.perf_counter()
@@ -257,5 +283,6 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                 os._exit(41)
 
     st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                     on_chunk=on_chunk, profiler=profiler, retune=retune)
+                     on_chunk=on_chunk, profiler=profiler, retune=retune,
+                     guard=guard, selfcheck=selfcheck)
     return st, hb

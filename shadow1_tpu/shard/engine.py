@@ -436,6 +436,16 @@ class ShardedEngine:
         base = int(st.metrics.x2x_overflow)
         out = self._get_run(self._x2x_cap)(st, jnp.asarray(n, jnp.int32))
         if not check_x2x:
+            # A supervising OverflowGuard passes check_x2x=False (through
+            # ckpt.run_chunked): the chunk-boundary policy then owns the
+            # response — retry grows the bucket via grow_x2x() and replays
+            # the chunk transactionally, halt raises the structured
+            # CapacityExceededError — so the eager escalate/raise below
+            # must not preempt it. The psum'd metrics already carry the
+            # global x2x_overflow count every shard agrees on. Guard-LESS
+            # callers keep this eager safety net no matter what
+            # params.on_overflow says: a policy nobody supervises must
+            # never mean silent loss.
             return out
         drops = int(out.metrics.x2x_overflow) - base
         if (drops and not base and not self.params.x2x_cap
@@ -470,6 +480,20 @@ class ShardedEngine:
                 f"EngineParams.x2x_cap or pass check_x2x=False"
             )
         return out
+
+    def grow_x2x(self) -> bool:
+        """Escalate the exchange bucket to its guaranteed-fit cap (the
+        overflow-retry hook, txn.OverflowGuard._grow). The bucket is not a
+        state shape, so no plane migration is involved — the grown cap
+        simply selects a different compiled program for the replay and all
+        subsequent chunks. Returns False when already at the fit cap (a
+        bucket physically cannot need more than the shard's whole outbox,
+        so a False here means the overflow is not bucket-sized — the guard
+        raises with that diagnosis)."""
+        if self._x2x_cap >= self._full_cap:
+            return False
+        self._x2x_cap = self._full_cap
+        return True
 
     metrics_dict = staticmethod(Engine.metrics_dict)
 

@@ -69,7 +69,18 @@ METRIC_SPECS: dict[str, tuple[str, str]] = {
     "fires_app": (COUNTER, "rounds where the K_APP pass fired"),
     "link_down_pkts": (COUNTER, "packets dropped: link outage window (fault plane)"),
     "host_restarts": (COUNTER, "host restart resets applied (fault plane churn)"),
+    "chunk_retries": (COUNTER, "chunks discarded and replayed after overflow "
+                               "(--on-overflow retry; txn.OverflowGuard)"),
+    "retry_windows_rerun": (COUNTER, "windows re-executed by overflow "
+                                     "chunk retries"),
 }
+
+# HOST-side counters (txn.OverflowGuard): maintained by the chunk runner on
+# the host, never in the device Metrics tuple — they ride the canonical
+# namespace (normalize/Prometheus) but are excluded from the Metrics-fields
+# sync contract, from heartbeat deltas (the retries block carries them) and
+# from ring percentile stats (chunk-level, not per-window).
+HOST_FIELDS = ("chunk_retries", "retry_windows_rerun")
 
 # JSONL record types every consumer recognises (docs/OBSERVABILITY.md).
 # ``digest`` is the CPU oracle's per-window state-digest row (the batched

@@ -33,6 +33,10 @@ import json
 import sys
 
 from shadow1_tpu.core.digest import DIGEST_FIELDS
+# The drop-accounting identity lives in the transactional plane now
+# (shadow1_tpu/txn.py) so `--selfcheck` runs it at every chunk/window
+# boundary of ANY run; this probe keeps using the same shared check.
+from shadow1_tpu.txn import accounting
 
 # Counters every side must agree on (includes the fault-plane set).
 VERDICT_KEYS = (
@@ -91,23 +95,6 @@ def run_side(spec, exp, params, n_windows, chunk):
         return _digest_rows_batch(ShardedEngine(exp, params, devices=devs),
                                   n_windows, chunk)
     raise SystemExit(f"unknown side spec {spec!r}")
-
-
-def accounting(m: dict) -> dict:
-    """The churn drop-accounting identity: where every sent packet went.
-    ``ev_overflow`` counts event-buffer drops from both local pushes and
-    deliveries; only the delivery share belongs here, so the identity is
-    checked as sent ≤ explained ≤ sent + ev_overflow (exact when
-    ev_overflow == 0 — overflow-free runs are the parity contract)."""
-    explained = (m["pkts_delivered"] + m["pkts_lost"] + m["link_down_pkts"]
-                 + m["down_pkts"] + m.get("x2x_overflow", 0))
-    lo, hi = explained, explained + m["ev_overflow"]
-    return {
-        "pkts_sent": m["pkts_sent"],
-        "explained": explained,
-        "ev_overflow": m["ev_overflow"],
-        "closes": lo <= m["pkts_sent"] <= hi,
-    }
 
 
 def main(argv=None) -> int:

@@ -126,6 +126,28 @@ def summarize(recs: list[dict], out=None) -> dict:
         for f, reason in DROP_SPECS.items():
             if drop_totals[f]:
                 print(f"  {f}: {drop_totals[f]}  ({reason})", file=out)
+    if hb:
+        # Overflow-retry plane (shadow1_tpu/txn.py): heartbeat ``retries``
+        # blocks carry CUMULATIVE host-side counters, so the last block is
+        # the run total. Chunk-level counters, not per-window rows — they
+        # are excluded from the ring percentile stats below by the same
+        # rule that keeps the digest identity columns out (PR 3): only
+        # RING_COUNTERS/RING_GAUGES rank there.
+        rt = [r["retries"] for r in hb if isinstance(r.get("retries"), dict)]
+        if rt:
+            last = rt[-1]
+            summary["retries"] = {
+                k: last.get(k)
+                for k in ("policy", "chunk_retries", "retry_windows_rerun")
+            }
+            print("== overflow retries (transactional chunks) ==", file=out)
+            print(f"  chunk_retries: {last.get('chunk_retries')}  "
+                  f"windows re-run: {last.get('retry_windows_rerun')}",
+                  file=out)
+            if isinstance(last.get("caps"), dict):
+                caps = "  ".join(f"{k}: {v}"
+                                 for k, v in last["caps"].items())
+                print(f"  final caps: {caps}", file=out)
     if rings:
         rs = ring_summary(rings)
         summary["ring"] = rs

@@ -90,6 +90,25 @@ class CapController:
             eng = self._engines[k] = self._make_engine(params)
         return eng
 
+    # Public alias: the overflow-retry guard (txn.OverflowGuard) builds its
+    # grown-cap engines through the controller's cache so the two planes
+    # share one jit cache per (ev_cap, outbox_cap) instead of compiling the
+    # same program twice.
+    engine_for = _engine_for
+
+    def note_lossy(self, knob: str, grown_cap: int) -> None:
+        """Absorb a retry-driven grow (txn.OverflowGuard): the pre-grow cap
+        is PROVEN lossy — the tainted chunk overflowed it — so the shrink
+        floor ratchets to the grown cap and the low-occupancy streak
+        resets. The controller can then never shrink back into a cap the
+        retry plane just had to grow away from (grow/retry/shrink
+        oscillation). The ``_overflow_seen`` baseline is deliberately NOT
+        advanced: the tainted chunk's counters were discarded with its
+        state, so the committed stream shows no fresh overflow and the
+        backstop cannot double-grow on top of the guard's grow."""
+        self._floor[knob] = max(self._floor[knob], int(grown_cap))
+        self._low_chunks[knob] = 0
+
     def _decide(self, knob: str, high_water: int, cap: int) -> int:
         import math
 

@@ -93,6 +93,40 @@ def test_cli_runs_rung1(capsys):
     assert out["metrics"]["events"] > 0
 
 
+def _phold_doc(**over):
+    doc = {
+        "general": {"seed": 1, "stop_time": "10 ms"},
+        "engine": {"scheduler": "tpu"},
+        "network": {"single_vertex": {"latency": "1 ms"}},
+        "hosts": [{"name": "h", "count": 2}],
+        "app": {"model": "phold"},
+    }
+    doc.update(over)
+    return doc
+
+
+def test_unknown_keys_fail_fast():
+    """Config hardening: a typo anywhere in the experiment schema fails at
+    load (fault/schedule.py-style rejection), never a silent default run."""
+    build_experiment(_phold_doc())  # the baseline doc itself is valid
+    cases = [
+        _phold_doc(egine={"scheduler": "tpu"}),               # top-level typo
+        _phold_doc(general={"seed": 1, "stop_tme": "10 ms"}),  # general typo
+        _phold_doc(network={"single_vertex": {"latncy": "1 ms"}}),
+        _phold_doc(network={"single_vertex": {"latency": "1 ms"},
+                            "jitterr": "1 us"}),
+        _phold_doc(hosts=[{"name": "h", "countt": 2}]),        # host typo
+        _phold_doc(app={"model": "phold", "prams": {}}),       # app typo
+    ]
+    for doc in cases:
+        with pytest.raises(AssertionError, match="unknown"):
+            build_experiment(doc)
+    # The engine section already rejected typos; keep that contract pinned.
+    with pytest.raises(AssertionError, match="unknown engine params"):
+        build_experiment(_phold_doc(engine={"scheduler": "tpu",
+                                            "ev_capp": 64}))
+
+
 def test_stagger_start_times():
     """Group param dict form {start, interval}: host i of the group gets
     start + i*interval (the rung-4 client-bootstrap stagger)."""

@@ -1,0 +1,399 @@
+"""Overflow-safe execution: transactional chunk retry, structured failure
+taxonomy, and in-run self-checks (shadow1_tpu/txn.py).
+
+The contract under test (docs/SEMANTICS.md "Capacities" overflow-recovery):
+a deliberately under-capped run under ``--on-overflow retry`` discards every
+tainted chunk, grows the offending cap one ladder step, replays the chunk
+from the saved chunk-start state — and its digest stream bit-matches a
+straight run of the same config at the final (grown) caps, on the cpu, tpu
+and sharded engines. ``halt`` raises the structured CapacityExceededError
+with paste-ready advice; the supervisor classifies that exit instead of
+crash-looping; ``--selfcheck`` guards the drop-accounting identity on
+every run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from shadow1_tpu.ckpt import load_state, run_chunked, snapshot_caps
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.core.digest import DIGEST_FIELDS
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+from shadow1_tpu.obs import run_with_heartbeat
+from shadow1_tpu.telemetry.ring import drain_ring
+from shadow1_tpu.txn import (
+    EXIT_CAPACITY,
+    CapacityExceededError,
+    OverflowGuard,
+    SelfCheckError,
+    check_boundary_identity,
+)
+
+N_WINDOWS = 40
+CHUNK = 10
+SMALL_CAP = 8  # overflows this workload (ev_max_fill reaches 14)
+
+
+def phold_exp():
+    """8-host PHOLD whose event concentration overflows ev_cap=8 within the
+    first chunk (seed-pinned; init seeds 6 events/host, far under the cap,
+    so all overflow is IN-window — the transactional case)."""
+    return single_vertex_experiment(
+        n_hosts=8, seed=5, end_time=N_WINDOWS * MS, latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 6},
+    )
+
+
+def params(ev_cap, **kw):
+    return EngineParams(ev_cap=ev_cap, metrics_ring=CHUNK, state_digest=1,
+                        **kw)
+
+
+def digest_stream(eng, guard=None, n_windows=N_WINDOWS, st=None):
+    """(window → digest tuple, final state) via the chunked runner, draining
+    the telemetry ring at every COMMITTED boundary."""
+    rows, start = {}, [int(st.metrics.windows) if st is not None else 0]
+
+    def on_chunk(s, _done):
+        for r in drain_ring(s, eng.window, start=start[0]):
+            if r["type"] == "ring":
+                rows[r["window"]] = tuple(r[f] for f in DIGEST_FIELDS)
+        start[0] = int(s.metrics.windows)
+
+    out = run_chunked(eng, st, n_windows=n_windows, chunk=CHUNK, guard=guard,
+                      on_chunk=on_chunk)
+    return rows, out
+
+
+# ---------------------------------------------------------------------------
+# The acceptance criterion: retry ≡ straight big-cap, cpu↔tpu↔sharded
+# ---------------------------------------------------------------------------
+
+def test_forced_overflow_retry_bitmatches_bigcap_straight():
+    exp = phold_exp()
+    # Sanity: the workload genuinely overflows the small cap.
+    st_lossy = Engine(exp, EngineParams(ev_cap=SMALL_CAP)).run(
+        n_windows=N_WINDOWS)
+    assert int(st_lossy.metrics.ev_overflow) > 0
+
+    eng = Engine(exp, params(SMALL_CAP))
+    guard = OverflowGuard(eng, make_engine=lambda p: Engine(exp, p),
+                          mode="retry")
+    rows_retry, st_retry = digest_stream(eng, guard)
+    assert guard.chunk_retries >= 1
+    assert guard.retry_windows_rerun >= CHUNK
+    final_cap = guard.final_caps["ev_cap"]
+    assert final_cap > SMALL_CAP
+    # Every committed chunk is overflow-free — that is what commit means.
+    assert int(st_retry.metrics.ev_overflow) == 0
+    assert len(rows_retry) == N_WINDOWS
+
+    # Straight big-cap truth, all three engines.
+    rows_tpu, st_tpu = digest_stream(Engine(exp, params(final_cap)))
+    assert rows_retry == rows_tpu
+    assert Engine.metrics_dict(st_retry) == Engine.metrics_dict(st_tpu)
+
+    ce = CpuEngine(exp, params(final_cap))
+    cm = ce.run(n_windows=N_WINDOWS)
+    rows_cpu = {r["window"]: tuple(r[f] for f in DIGEST_FIELDS)
+                for r in ce.digest_rows}
+    assert set(rows_cpu) == set(rows_retry)
+    assert rows_cpu == rows_retry
+    for k in ("events", "pkts_sent", "pkts_delivered", "pkts_lost",
+              "ev_overflow"):
+        assert cm[k] == Engine.metrics_dict(st_retry)[k], k
+
+    from shadow1_tpu.shard.engine import ShardedEngine
+
+    rows_sh, _ = digest_stream(ShardedEngine(exp, params(final_cap)))
+    assert rows_sh == rows_retry
+
+
+def test_sharded_retry_all_shards_together():
+    """The guard drives the sharded engine too: overflow deltas are psum'd
+    (every shard agrees on the global count), the grown engine reshards the
+    migrated state, and the replayed stream matches the single-device
+    retry run exactly."""
+    from shadow1_tpu.shard.engine import ShardedEngine
+
+    exp = phold_exp()
+    eng = ShardedEngine(exp, params(SMALL_CAP, on_overflow="retry"))
+    guard = OverflowGuard(eng, make_engine=lambda p: ShardedEngine(exp, p),
+                          mode="retry")
+    rows_sh, st_sh = digest_stream(eng, guard)
+    assert guard.chunk_retries >= 1
+    assert int(st_sh.metrics.ev_overflow) == 0
+
+    eng1 = Engine(exp, params(SMALL_CAP))
+    g1 = OverflowGuard(eng1, make_engine=lambda p: Engine(exp, p),
+                       mode="retry")
+    rows_1, _ = digest_stream(eng1, g1)
+    assert guard.final_caps["ev_cap"] == g1.final_caps["ev_cap"]
+    assert rows_sh == rows_1
+
+
+def test_retry_grows_outbox_cap_for_drop_counted_models():
+    """ob_overflow drives the same transaction for models whose outbox use
+    is drop-counted (PHOLD — the docs/SEMANTICS.md outbox_cap caveat names
+    the flow-controlled TCP boundary where this would NOT be bit-exact)."""
+    import dataclasses
+
+    exp = phold_exp()
+    p_small = dataclasses.replace(params(32), outbox_cap=4)
+    st_lossy = Engine(exp, p_small).run(n_windows=N_WINDOWS)
+    assert int(st_lossy.metrics.ob_overflow) > 0
+
+    eng = Engine(exp, p_small)
+    guard = OverflowGuard(eng, make_engine=lambda p: Engine(exp, p),
+                          mode="retry")
+    rows, st = digest_stream(eng, guard)
+    assert guard.chunk_retries >= 1
+    assert int(st.metrics.ob_overflow) == 0
+    ob_final = guard.final_caps["outbox_cap"]
+    assert ob_final > 4
+    rows_ref, _ = digest_stream(
+        Engine(exp, dataclasses.replace(params(32), outbox_cap=ob_final)))
+    assert rows == rows_ref
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/resume through a retried run
+# ---------------------------------------------------------------------------
+
+def test_resume_from_ckpt_of_retried_run_bit_identical(tmp_path):
+    """A checkpoint taken mid-run after retries were replayed is saved at
+    the GROWN caps; the respawn recipe (rebuild the engine at the
+    snapshot's caps — ckpt.snapshot_caps, as cli.py does under retry) must
+    continue the digest stream bit-identically to the straight big-cap
+    run."""
+    exp = phold_exp()
+    path = str(tmp_path / "retry.npz")
+
+    eng = Engine(exp, params(SMALL_CAP))
+    guard = OverflowGuard(eng, make_engine=lambda p: Engine(exp, p),
+                          mode="retry")
+    st, hb = run_with_heartbeat(eng, n_windows=N_WINDOWS // 2,
+                                every_windows=CHUNK, stream=False,
+                                ckpt_path=path, ckpt_every_s=0.0,
+                                guard=guard)
+    assert guard.chunk_retries >= 1  # the snapshot postdates a retry
+    rows = {r["window"]: tuple(r[f] for f in DIGEST_FIELDS)
+            for r in hb.ring_records if r["type"] == "ring"}
+
+    # Supervised-respawn recipe: engine at the snapshot's caps, then resume.
+    snap = snapshot_caps(Engine(exp, params(SMALL_CAP)).init_state(), path)
+    assert snap is not None and snap[0] > SMALL_CAP
+    eng2 = Engine(exp, params(snap[0], outbox_cap=snap[1]))
+    st2 = load_state(eng2.init_state(), path)
+    guard2 = OverflowGuard(eng2, make_engine=lambda p: Engine(exp, p),
+                           mode="retry")
+    st2, hb2 = run_with_heartbeat(eng2, st2, n_windows=N_WINDOWS // 2,
+                                  every_windows=CHUNK, stream=False,
+                                  guard=guard2)
+    for r in hb2.ring_records:
+        if r["type"] == "ring":
+            rows[r["window"]] = tuple(r[f] for f in DIGEST_FIELDS)
+
+    rows_ref, st_ref = digest_stream(
+        Engine(exp, params(guard2.final_caps["ev_cap"])))
+    assert set(rows) == set(rows_ref) and rows == rows_ref
+    for k, v in Engine.metrics_dict(st_ref).items():
+        assert Engine.metrics_dict(st2)[k] == v, k
+
+
+# ---------------------------------------------------------------------------
+# halt: the structured failure taxonomy
+# ---------------------------------------------------------------------------
+
+def test_halt_raises_structured_capacity_error():
+    exp = phold_exp()
+    eng = Engine(exp, params(SMALL_CAP, on_overflow="halt"))
+    guard = OverflowGuard(eng, mode="halt")
+    with pytest.raises(CapacityExceededError) as ei:
+        run_chunked(eng, n_windows=N_WINDOWS, chunk=CHUNK, guard=guard)
+    e = ei.value
+    assert e.knob == "ev_cap" and e.counter == "ev_overflow"
+    assert e.cap == SMALL_CAP and e.overflow > 0
+    assert e.window_range == (0, CHUNK)  # first chunk is already lossy
+    assert e.recommended > SMALL_CAP
+    # Paste-ready advice: an engine: YAML block plus the sizing tool.
+    assert e.advice.startswith("engine:")
+    assert f"ev_cap: {e.recommended}" in e.advice
+    assert "captune" in str(e) and "--on-overflow retry" in str(e)
+
+
+def test_cpu_oracle_halt_same_boundary_check():
+    exp = phold_exp()
+    with pytest.raises(CapacityExceededError) as ei:
+        CpuEngine(exp, EngineParams(ev_cap=SMALL_CAP,
+                                    on_overflow="halt")).run(
+            n_windows=N_WINDOWS)
+    e = ei.value
+    assert e.knob == "ev_cap" and e.overflow > 0
+    # Window-granularity attribution on the oracle (vs chunk on batch).
+    assert e.window_range[1] - e.window_range[0] == 1
+
+
+def test_retry_aborts_at_ladder_top_with_diagnosis():
+    """A cap that cannot grow (policy max) must abort with the structured
+    error, not loop forever."""
+    exp = phold_exp()
+    eng = Engine(exp, params(SMALL_CAP))
+    guard = OverflowGuard(eng, make_engine=lambda p: Engine(exp, p),
+                          mode="retry", max_cap=SMALL_CAP)
+    with pytest.raises(CapacityExceededError, match="ladder top"):
+        run_chunked(eng, n_windows=N_WINDOWS, chunk=CHUNK, guard=guard)
+
+
+# ---------------------------------------------------------------------------
+# Self-check: the drop-accounting identity on every run
+# ---------------------------------------------------------------------------
+
+def test_selfcheck_clean_on_every_engine():
+    exp = phold_exp()
+    run_chunked(Engine(exp, EngineParams(ev_cap=32)), n_windows=N_WINDOWS,
+                chunk=CHUNK, selfcheck=True)
+    CpuEngine(exp, EngineParams(ev_cap=32, selfcheck=1)).run(
+        n_windows=N_WINDOWS)
+
+
+def test_selfcheck_violation_names_counters():
+    with pytest.raises(SelfCheckError) as ei:
+        check_boundary_identity(
+            {"pkts_sent": 10, "pkts_delivered": 4, "pkts_lost": 1,
+             "ev_overflow": 2}, where="window 7")
+    e = ei.value
+    assert e.gap == 5 and e.where == "window 7"
+    assert e.terms["pkts_sent"] == 10 and e.terms["pkts_delivered"] == 4
+    msg = str(e)
+    assert "pkts_sent" in msg and "uncounted" in msg and "window 7" in msg
+    # Over-explained direction (double count) is named distinctly.
+    with pytest.raises(SelfCheckError, match="counted twice"):
+        check_boundary_identity({"pkts_sent": 3, "pkts_delivered": 4})
+
+
+# ---------------------------------------------------------------------------
+# Autocap interplay: the controller absorbs retry-driven grows
+# ---------------------------------------------------------------------------
+
+def test_controller_absorbs_retry_grow_never_shrinks_back():
+    from shadow1_tpu.tune.autocap import CapController, CapPolicy
+
+    exp = phold_exp()
+    ctl = CapController(Engine(exp, params(SMALL_CAP)),
+                        lambda p: Engine(exp, p),
+                        policy=CapPolicy(shrink_patience=1))
+    ctl.note_lossy("ev_cap", 24)
+    assert ctl._floor["ev_cap"] == 24
+    # A shrink decision for a low high-water must clamp at the floor, not
+    # fall back into the proven-overflowing range.
+    assert ctl._decide("ev_cap", high_water=4, cap=24) == 24
+    # And the guard shares the controller's engine cache.
+    eng24 = ctl.engine_for(params(24))
+    guard = OverflowGuard(eng24, mode="retry", controller=ctl)
+    assert guard._engine_for(params(24)) is eng24
+
+
+def test_retry_with_autocaps_attached_converges():
+    """retry + --auto-caps in one run: the guard grows through the
+    controller's cache and ratchets its lossless floor, so the pair
+    converges to an overflow-free cap with no grow/shrink oscillation —
+    the controller's shrink side (patience 1, maximally eager) never
+    re-enters the proven-overflowing range."""
+    from shadow1_tpu.tune.autocap import CapController, CapPolicy
+
+    exp = phold_exp()
+    eng = Engine(exp, params(SMALL_CAP))
+    ctl = CapController(eng, lambda p: Engine(exp, p),
+                        policy=CapPolicy(shrink_patience=1))
+    guard = OverflowGuard(eng, mode="retry", controller=ctl)
+    st = run_chunked(eng, n_windows=N_WINDOWS, chunk=CHUNK, guard=guard,
+                     retune=ctl)
+    assert guard.chunk_retries >= 1
+    assert int(st.metrics.ev_overflow) == 0
+    # The guard grew at least one ladder step and the floor absorbed it.
+    assert ctl._floor["ev_cap"] >= 12
+    # Every controller resize respected the lossy floor — no oscillation.
+    assert all(rec["ev_cap"][1] >= 12 for rec in ctl.resizes)
+
+
+# ---------------------------------------------------------------------------
+# CLI + supervisor (subprocess): exit taxonomy and reporting
+# ---------------------------------------------------------------------------
+
+def _write_undercapped_cfg(tmp_path) -> str:
+    cfg = tmp_path / "of_phold.yaml"
+    cfg.write_text(
+        "general: {seed: 5, stop_time: 40 ms}\n"
+        "engine: {scheduler: tpu, ev_cap: 8}\n"
+        "network: {single_vertex: {latency: 1 ms}}\n"
+        "hosts:\n"
+        "  - {name: h, count: 8}\n"
+        "app:\n"
+        "  model: phold\n"
+        "  params: {mean_delay_ns: 2000000.0, init_events: 6}\n"
+    )
+    return str(cfg)
+
+
+def test_cli_retry_reports_counters_and_halt_exit_code(tmp_path):
+    """The acceptance reporting: chunk_retries ≥ 1 in the heartbeat
+    ``retries`` block AND the final JSON; halt exits EXIT_CAPACITY with a
+    parseable error record."""
+    cfg = _write_undercapped_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow1_tpu", cfg, "--on-overflow", "retry",
+         "--heartbeat", "10"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["retries"]["chunk_retries"] >= 1
+    assert out["retries"]["caps"]["ev_cap"] > SMALL_CAP
+    assert out["metrics"]["chunk_retries"] >= 1
+    assert out["metrics"]["ev_overflow"] == 0  # committed stream is clean
+    hb = [json.loads(x) for x in r.stderr.splitlines()
+          if x.startswith("{") and '"heartbeat"' in x]
+    assert any(b.get("retries", {}).get("chunk_retries", 0) >= 1 for b in hb)
+
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow1_tpu", cfg, "--on-overflow", "halt"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_CAPACITY, (r.returncode, r.stderr[-600:])
+    err = json.loads(r.stdout.strip().splitlines()[-1])
+    assert err["error"] == "capacity_exceeded" and err["knob"] == "ev_cap"
+    assert err["recommended"] > SMALL_CAP
+    assert "Paste-ready fix" in r.stderr and "engine:" in r.stderr
+
+
+def test_supervisor_classifies_capacity_halt_without_crash_loop(tmp_path):
+    """--ckpt supervision over a halting child: EXIT_CAPACITY is a
+    deterministic config condition — the supervisor must classify and stop,
+    never respawn (mirrors the PR 4 no-progress classifier)."""
+    cfg = _write_undercapped_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SHADOW1_SUPERVISE_BACKOFF_S": "0"}
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow1_tpu", cfg, "--on-overflow", "halt",
+         "--ckpt", str(tmp_path / "ck.npz"), "--heartbeat", "10"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_CAPACITY, (r.returncode, r.stderr[-600:])
+    assert "halted on a capacity policy" in r.stderr
+    assert "respawning (" not in r.stderr  # zero respawn attempts
+
+
+def test_cli_rejects_retry_on_cpu_engine(tmp_path, capsys):
+    from shadow1_tpu.cli import main
+
+    cfg = _write_undercapped_cfg(tmp_path)
+    with pytest.raises(SystemExit) as ei:
+        main([cfg, "--engine", "cpu", "--on-overflow", "retry"])
+    assert ei.value.code == 2  # argparse usage error, like the other flags
+    assert "batched engine" in capsys.readouterr().err
