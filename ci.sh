@@ -26,7 +26,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -111,6 +111,40 @@ EOF
     grep -q "Paste-ready fix" /tmp/_of_halt.log || { echo "halt: advice missing" >&2; exit 1; }
     echo "halt: exit 4 with paste-ready cap advice ok"
     rm -f "$of_cfg" /tmp/_of_halt.log
+    echo "== fleet digest-parity smoke (3-experiment sweep vs solo, cpu+tpu) =="
+    # A 3-experiment fleet (seed change, loss-rate change, churn schedule)
+    # run as ONE vmapped program: every lane's per-window digest stream
+    # must be bit-identical to running that experiment alone, on both the
+    # solo batched engine and the cpu oracle (the fleet contract,
+    # docs/SEMANTICS.md).
+    fl_cfg=$(mktemp /tmp/shadow1_fl_XXXX.yaml)
+    cat > "$fl_cfg" <<'YAML'
+general: {seed: 7, stop_time: 80 ms}
+engine: {scheduler: tpu, ev_cap: 32, outbox_cap: 16}
+network: {single_vertex: {latency: 10 ms}}
+hosts:
+  - {name: h, count: 8}
+app:
+  model: phold
+  params: {mean_delay_ns: 2.0e7, init_events: 2}
+sweep:
+  seeds: [7, 8, 9]
+  vary:
+    - {}
+    - {network: {single_vertex: {loss: 0.05}}}
+    - {faults: {hosts: [{group: h, down_at: 30 ms, up_at: 60 ms}]}}
+YAML
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.fleetprobe \
+        "$fl_cfg" --sides tpu,cpu 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+assert d["experiments"] == 3, d
+assert d["streams_compared"] == {"tpu": 3, "cpu": 3}, d
+print("fleetprobe: 3 experiments x", d["windows"],
+      "windows bit-identical fleet<->solo on tpu and cpu sides")
+'
+    rm -f "$fl_cfg"
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
 import tempfile, os

@@ -44,7 +44,15 @@ import jax
 #      CorruptCheckpointError instead of resuming from garbage, and the
 #      supervisor (cli._supervise) discards a corrupt checkpoint like a
 #      stale one rather than crash-looping on it.
-CKPT_FORMAT = 8
+#   9: fleet mode (shadow1_tpu/fleet/) — a snapshot may now hold a FLEET
+#      state: every leaf carries a leading [E] experiment axis (event
+#      buffers [E,C,H], metrics [E], rings [E,W,F]). Solo snapshots are
+#      unchanged in layout, but the format is bumped so a fleet/solo
+#      mixup fails as a version/shape error with this history to point at
+#      rather than a confusing leaf-shape one. Per-experiment resume
+#      slicing (fleet.engine.slice_experiment) re-saves one lane as a
+#      plain solo snapshot.
+CKPT_FORMAT = 9
 
 
 class CorruptCheckpointError(ValueError):

@@ -157,6 +157,83 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
     return rc
 
 
+def _fleet_main(args, params, plan, log, t0, capacity_exit) -> int:
+    """The --fleet execution path: one FleetEngine run over the expanded
+    sweep, per-experiment final records + a fleet summary on stdout
+    (docs/OBSERVABILITY.md §"Fleet records")."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from shadow1_tpu.fleet.engine import FleetEngine
+    from shadow1_tpu.fleet.run import final_records, run_fleet
+    from shadow1_tpu.txn import CapacityExceededError
+
+    eng = FleetEngine(plan.exps, params, plan.max_rounds)
+    log.info("fleet expanded", experiments=eng.n_exp,
+             hosts=eng.exp.n_hosts, window_ns=eng.window)
+    st = None
+    metrics0 = None
+    # Same resume precedence as the solo path: a --ckpt snapshot on disk
+    # (the newer state a supervised respawn continues from) wins over an
+    # explicit --resume. The snapshot is the WHOLE fleet ([E, ...] leaves).
+    resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
+                   else args.resume)
+    if resume_path:
+        from shadow1_tpu.ckpt import CorruptCheckpointError, load_state
+
+        try:
+            st = load_state(eng.init_state(), resume_path)
+        except CorruptCheckpointError as e:
+            # Same policy as the solo path: a supervised child must not
+            # crash-loop the respawn budget on a snapshot corrupted after
+            # the parent's pre-spawn verification — fall back to a fresh
+            # start. An explicit --resume keeps failing loudly.
+            if resume_path != args.ckpt:
+                raise
+            log.warning("discarding corrupt fleet checkpoint",
+                        path=resume_path, reason=str(e))
+            st, resume_path = None, None
+        else:
+            metrics0 = eng.metrics_per_exp(st)
+            done = int(np.asarray(st.win_start).max()) // eng.window
+            if args.windows is None:
+                args.windows = max(eng.n_windows - done, 0)
+            elif resume_path == args.ckpt:
+                # Supervised respawn: --windows is the TOTAL for the whole
+                # supervised run, not N more on top of the snapshot.
+                args.windows = max(args.windows - done, 0)
+    ring_w = params.metrics_ring
+    try:
+        st, _hb = run_fleet(
+            eng, st, n_windows=args.windows,
+            every_windows=args.heartbeat or (ring_w or None),
+            stream=None if (args.heartbeat or ring_w) else False,
+            ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
+            emit_heartbeat=bool(args.heartbeat),
+            emit_ring=bool(ring_w),
+            selfcheck=bool(params.selfcheck),
+            labels=plan.labels,
+        )
+        jax.block_until_ready(st)
+    except CapacityExceededError as e:
+        return capacity_exit(e)
+    if args.save_state:
+        from shadow1_tpu.ckpt import save_state
+
+        save_state(st, args.save_state)
+    wall = time.perf_counter() - t0
+    n_windows = args.windows if args.windows is not None else eng.n_windows
+    recs, summary = final_records(eng, st, plan.labels, n_windows, wall,
+                                  resumed=bool(resume_path),
+                                  metrics0=metrics0)
+    for r in recs:
+        print(json.dumps(r))
+    print(json.dumps(summary))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="shadow1_tpu",
@@ -245,6 +322,16 @@ def main(argv=None) -> int:
                          "`off` runs the same experiment with the schedule "
                          "stripped (the healthy-world A/B); the legacy "
                          "per-group stop_time churn is unaffected")
+    ap.add_argument("--fleet", action="store_true",
+                    help="batched experiment sweep (shadow1_tpu/fleet/): "
+                         "expand the config's `sweep:` section into E "
+                         "experiment variants (seeds, loss rates, fault "
+                         "schedules — one topology shape class) and run "
+                         "them as ONE vmapped device program. Emits one "
+                         "fleet_exp JSON record per experiment plus a "
+                         "fleet_summary line; per-experiment digest "
+                         "streams are bit-identical to solo runs "
+                         "(docs/SEMANTICS.md §'Fleet contract')")
     ap.add_argument("--log-level", default="message",
                     choices=["error", "warning", "message", "info", "debug"],
                     help="stderr log verbosity (reference --log-level analogue)")
@@ -296,6 +383,59 @@ def main(argv=None) -> int:
                  "--ckpt/--trace/--metrics-ring/--auto-caps/"
                  "--on-overflow retry require a batched engine "
                  "(tpu or sharded)")
+    if args.fleet:
+        bad = [f for f, v in (("--tracker", args.tracker),
+                              ("--summary", args.summary),
+                              ("--profile", args.profile),
+                              ("--trace", args.trace)) if v]
+        if bad:
+            ap.error(f"--fleet does not support {', '.join(bad)}: "
+                     f"per-experiment tracker/summary/phase traces are a "
+                     f"follow-up; use the fleet_exp records and "
+                     f"--metrics-ring (per-experiment rows)")
+        from shadow1_tpu.fleet.expand import FleetConfigError
+
+        def _fleet_config_exit(e: FleetConfigError) -> int:
+            """Structured fleet rejection: message on stderr, one
+            parseable JSON record on stdout, config exit code."""
+            print(f"FleetConfigError: {e}", file=sys.stderr, flush=True)
+            print(json.dumps({"error": "fleet_config", "kind": e.kind,
+                              "knob": e.knob, "message": str(e)}))
+            return 2
+        if engine_kind != "tpu":
+            return _fleet_config_exit(FleetConfigError(
+                f"--fleet batches the single-device tpu engine; "
+                f"engine={engine_kind!r} is not composable with the "
+                f"experiment axis yet (run the sweep's experiments solo "
+                f"on that engine, or drop --engine)", kind="mode",
+                knob="engine"))
+        if auto_caps:
+            return _fleet_config_exit(FleetConfigError(
+                "--auto-caps is not available under --fleet: cap "
+                "migration is per-experiment host-side state surgery; "
+                "size caps from a sweep captune pass instead", kind="mode",
+                knob="auto_caps"))
+        if params.on_overflow == "retry":
+            return _fleet_config_exit(FleetConfigError(
+                "--on-overflow retry is not available under --fleet; use "
+                "halt (names the overflowing experiment) or size caps "
+                "with captune", kind="mode", knob="on_overflow"))
+        # Validate the sweep BEFORE any supervision/backend work: a
+        # malformed sweep must fail once in the parent, not crash-loop
+        # supervised children.
+        from shadow1_tpu.fleet.expand import load_sweep
+
+        try:
+            fleet_plan = load_sweep(args.config)
+        except FleetConfigError as e:
+            return _fleet_config_exit(e)
+        if args.faults == "off":
+            # Healthy-world A/B, fleet-shaped: strip every experiment's
+            # fault schedule (including ones a vary[] entry added) exactly
+            # like the solo path strips exp.faults; legacy per-group
+            # stop_time churn stays, same as solo.
+            for fexp in fleet_plan.exps:
+                fexp.faults = None
     if args.ckpt and args.resume and args.windows is not None:
         # Under supervision --windows is the TOTAL for the whole run; under
         # --resume it means N MORE windows. Combining all three makes a
@@ -344,6 +484,17 @@ def main(argv=None) -> int:
             "recommended": e.recommended,
         }))
         return EXIT_CAPACITY
+
+    if args.fleet:
+        from shadow1_tpu.fleet.expand import FleetConfigError
+
+        try:
+            return _fleet_main(args, params, fleet_plan, log, t0,
+                               _capacity_exit)
+        except FleetConfigError as e:
+            # Late rejections (FleetEngine construction) use the same
+            # structured exit as the early validation block above.
+            return _fleet_config_exit(e)
 
     if engine_kind == "cpu":
         from shadow1_tpu.cpu_engine import CpuEngine

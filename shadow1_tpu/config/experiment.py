@@ -278,7 +278,10 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
 
     _reject_unknown("top-level config", doc,
                     ("general", "engine", "network", "hosts", "app",
-                     "faults"))
+                     "faults", "sweep"))
+    # ``sweep:`` belongs to fleet mode (shadow1_tpu/fleet/expand.py): a solo
+    # run of a sweep config runs the BASE experiment; its section schema is
+    # validated there, at --fleet expansion time.
     gen = doc.get("general", {})
     _reject_unknown("general:", gen, ("seed", "stop_time"))
     seed = int(gen.get("seed", 1))

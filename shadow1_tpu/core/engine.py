@@ -661,6 +661,29 @@ def fidelity_ctx_kwargs(exp) -> dict:
     )
 
 
+def build_base_ctx(exp: CompiledExperiment, params: EngineParams,
+                   window: int | None = None) -> Ctx:
+    """The single-device Ctx for a CompiledExperiment — topology constants,
+    fidelity tables, fault plane, per-experiment RNG key. Shared by Engine
+    below and the batched-experiment FleetEngine (shadow1_tpu/fleet/),
+    which swaps the per-experiment leaves (key, loss thresholds, fault
+    tables) per vmapped lane."""
+    return Ctx(
+        n_hosts=exp.n_hosts,
+        n_total=exp.n_hosts,
+        params=params,
+        window=window if window is not None else exp.window,
+        key=rng.base_key(exp.seed),
+        lat_vv=jnp.asarray(exp.lat_vv, jnp.int64),
+        loss_vv=jnp.asarray(exp.loss_vv, jnp.float32),
+        host_vertex=jnp.asarray(exp.host_vertex, jnp.int32),
+        bw_up=jnp.asarray(exp.bw_up, jnp.int64),
+        bw_dn=jnp.asarray(exp.bw_dn, jnp.int64),
+        model_cfg=exp.model_cfg,
+        **fidelity_ctx_kwargs(exp),
+    )
+
+
 def check_digest_params(params: EngineParams) -> None:
     """state_digest needs a telemetry ring to carry the words on the
     batched engines (the CPU oracle keeps its own rows and has no ring)."""
@@ -723,20 +746,7 @@ class Engine:
         self.params = _resolve_kernel_impls(self.params, exp.n_hosts)
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
-        self.ctx = Ctx(
-            n_hosts=exp.n_hosts,
-            n_total=exp.n_hosts,
-            params=self.params,
-            window=self.window,
-            key=rng.base_key(exp.seed),
-            lat_vv=jnp.asarray(exp.lat_vv, jnp.int64),
-            loss_vv=jnp.asarray(exp.loss_vv, jnp.float32),
-            host_vertex=jnp.asarray(exp.host_vertex, jnp.int32),
-            bw_up=jnp.asarray(exp.bw_up, jnp.int64),
-            bw_dn=jnp.asarray(exp.bw_dn, jnp.int64),
-            model_cfg=exp.model_cfg,
-            **fidelity_ctx_kwargs(exp),
-        )
+        self.ctx = build_base_ctx(exp, self.params, window=self.window)
         self._model = _model_module(exp.model)
         if self.ctx.has_restart:
             # Restart target: the model pytree exactly as init() builds it

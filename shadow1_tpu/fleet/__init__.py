@@ -1,0 +1,20 @@
+"""Fleet mode — batched experiment sweeps as one device program.
+
+``expand``  — jax-free ``sweep:`` section expansion + fleet-contract
+              validation (FleetPlan, FleetConfigError).
+``engine``  — FleetEngine: E experiment variants vmapped over a leading
+              experiment axis through the single-device window loop.
+``run``     — the chunked fleet runner (per-experiment ring drain,
+              heartbeats, checkpoints, per-experiment final records).
+
+Contract: docs/SEMANTICS.md §"Fleet contract"; record schemas:
+docs/OBSERVABILITY.md §"Fleet records".
+"""
+
+from shadow1_tpu.fleet.expand import (  # noqa: F401
+    FleetConfigError,
+    FleetPlan,
+    expand_sweep,
+    expand_sweep_docs,
+    load_sweep,
+)

@@ -1,0 +1,396 @@
+"""FleetEngine — E experiment variants as one vmapped device program.
+
+The paper's round economics say a conservative window costs roughly
+kernel-count × fixed per-kernel launch cost, so a second experiment riding
+the same jitted window loop is nearly free. This engine makes that the
+serving shape: E experiments of ONE topology shape class (same host count,
+same latencies, same capacities — docs/SEMANTICS.md §"Fleet contract")
+stack onto a leading experiment axis and run through ``jax.vmap`` of the
+exact single-device ``window_step``:
+
+* every ``SimState`` leaf grows a leading ``[E, ...]`` axis (event
+  buffers ``[E, C, H]``, metrics ``[E]``, telemetry rings ``[E, W, F]``);
+* the per-experiment *variants* — RNG key, loss thresholds, fault tables,
+  ``max_rounds`` — ride a batched pytree zipped with the state, so lane e
+  executes with exactly the constants a solo run of experiment e would
+  close over;
+* everything trace-structural is shared: one compiled program, one launch
+  per chunk, per-window cost = the max lane's round count.
+
+**Per-experiment determinism contract**: lane e's digest stream, metrics
+and model state are bit-identical to running experiment e alone (solo tpu
+engine or the cpu oracle) — ``tools/fleetprobe.py`` verifies it, and
+``tests/test_fleet.py`` asserts it per PR. The mechanism: ``vmap`` batches
+the identical integer ops (RNG is counter-based per (key, host, ctr), so
+lanes cannot interact), and the batched ``while_loop`` freezes finished
+lanes with per-lane selects, so even per-lane ``rounds`` counts stay
+exact.
+
+What the fleet plane deliberately rejects (structured FleetConfigError,
+``kind="mode"``): the sharded engine (vmap-over-shard_map composition is a
+follow-up), ``--auto-caps`` and ``--on-overflow retry`` (cap migration is
+host-side state surgery per lane; growing for ALL lanes on one lane's
+overflow would silently change every other lane's cost envelope — run the
+sweep at captune'd caps instead, or use ``halt`` which names the offending
+experiment). Pallas kernel impls and sparse-window compaction downgrade to
+their XLA/full-width twins with a warning (bit-identical by contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu import rng
+from shadow1_tpu.config.compiled import NO_STOP
+from shadow1_tpu.consts import EngineParams
+from shadow1_tpu.core.engine import (
+    Ctx,
+    SimState,
+    _metrics_init,
+    _model_module,
+    build_base_ctx,
+    check_digest_params,
+    window_step,
+)
+from shadow1_tpu.core.events import evbuf_init
+from shadow1_tpu.core.outbox import outbox_init
+from shadow1_tpu.fleet.expand import FleetConfigError, check_uniform
+
+
+def slice_experiment(st: SimState, e: int) -> SimState:
+    """Lane e of a fleet state as a standalone solo SimState (leading
+    experiment axis stripped from every leaf). The per-experiment resume
+    slice: saved via ckpt.save_state it loads into a solo Engine of the
+    same config and continues bit-identically (tests/test_fleet.py)."""
+    return jax.tree.map(lambda x: x[e], st)
+
+
+def fleet_metrics_per_exp(st: SimState) -> list[dict[str, int]]:
+    """Per-experiment metric dicts from a fleet state ([E] leaves)."""
+    arrs = {k: np.asarray(v) for k, v in st.metrics._asdict().items()}
+    n = len(next(iter(arrs.values())))
+    return [{k: int(v[e]) for k, v in arrs.items()} for e in range(n)]
+
+
+def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0
+                      ) -> list[dict]:
+    """Per-experiment telemetry-ring drain: the solo ``drain_ring`` per
+    lane, each record tagged with its experiment id (``exp``) — the shape
+    tools/heartbeat_report.py and captune group by (docs/OBSERVABILITY.md
+    §fleet). TWO device→host fetches total (the [E, W, F] ring and the
+    window counters), then pure numpy lane views — never a per-lane slice
+    of the whole fleet state."""
+    from types import SimpleNamespace
+
+    from shadow1_tpu.telemetry.ring import drain_ring
+
+    if getattr(st, "telem", None) is None:
+        return []
+    buf = np.asarray(st.telem.buf)               # [E, W, F]
+    windows = np.asarray(st.metrics.windows)     # [E]
+    recs: list[dict] = []
+    for e in range(buf.shape[0]):
+        lane = SimpleNamespace(
+            telem=SimpleNamespace(buf=buf[e]),
+            metrics=SimpleNamespace(windows=int(windows[e])),
+        )
+        for r in drain_ring(lane, window_ns, start=start):
+            recs.append({**r, "exp": e})
+    return recs
+
+
+def _stack_host_intervals(exps) -> tuple[np.ndarray, np.ndarray]:
+    """Per-experiment [K_i, H] down/up interval tensors → [E, Kmax, H],
+    padded with the empty [NO_STOP, NO_STOP) interval no time satisfies."""
+    from shadow1_tpu.fault.schedule import host_interval_tensors
+
+    tabs = [host_interval_tensors(e) for e in exps]
+    h = exps[0].n_hosts
+    kmax = max((d.shape[0] for d, _ in tabs), default=0)
+    kmax = max(kmax, 1)  # keep a well-formed [E, 1, H] even when fault-free
+    downs, ups = [], []
+    for d, u in tabs:
+        pad = kmax - d.shape[0]
+        if pad:
+            filler = np.full((pad, h), NO_STOP, np.int64)
+            d = np.concatenate([d, filler]) if d.size else filler
+            u = np.concatenate([u, filler]) if u.size else filler
+        downs.append(d)
+        ups.append(u)
+    return np.stack(downs), np.stack(ups)
+
+
+def _stack_link_tables(exps):
+    """[E, Lmax] (src, dst, t0, t1) link-outage tables, or None when no
+    experiment has any. Padding rows use t0 == t1 == 0 — an empty outage
+    window no departure time can hit."""
+    from shadow1_tpu.fault.schedule import link_tables
+
+    tabs = [link_tables(e) for e in exps]
+    if all(t is None for t in tabs):
+        return None
+    lmax = max(len(t[0]) for t in tabs if t is not None)
+
+    def pad(t):
+        if t is None:
+            t = (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                 np.zeros(0, np.int64), np.zeros(0, np.int64))
+        n = lmax - len(t[0])
+        return tuple(np.concatenate([np.asarray(a), np.zeros(n, a.dtype)])
+                     for a in t)
+
+    cols = [pad(t) for t in tabs]
+    return tuple(np.stack([c[i] for c in cols]) for i in range(4))
+
+
+def _stack_ramp_tables(exps):
+    """[E, Rmax] (src, dst, t0, t1, thr) loss-ramp tables, or None.
+    Padding rows are inert the same way (t0 == t1)."""
+    from shadow1_tpu.fault.schedule import ramp_tables
+
+    tabs = [ramp_tables(e) for e in exps]
+    if all(t is None for t in tabs):
+        return None
+    rmax = max(len(t[0]) for t in tabs if t is not None)
+
+    def pad(t):
+        if t is None:
+            t = (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                 np.zeros(0, np.int64), np.zeros(0, np.int64),
+                 np.zeros(0, np.uint64))
+        n = rmax - len(t[0])
+        return tuple(np.concatenate([np.asarray(a), np.zeros(n, a.dtype)])
+                     for a in t)
+
+    cols = [pad(t) for t in tabs]
+    return tuple(np.stack([c[i] for c in cols]) for i in range(5))
+
+
+class FleetEngine:
+    """Batched engine over E CompiledExperiments of one shape class.
+
+    API mirrors core.engine.Engine where the chunk runners need it
+    (``init_state`` / ``run`` / ``place_state`` / ``n_windows`` /
+    ``window`` / ``params``), plus the per-experiment accessors
+    (``metrics_per_exp`` / ``slice_experiment`` / ``drain_rings``).
+    ``metrics_dict`` returns the FLEET AGGREGATE (counters summed, gauges
+    maxed) so generic chunk plumbing keeps working; anything that needs
+    the real contract reads the per-experiment dicts."""
+
+    def __init__(self, exps: list, params: EngineParams | None = None,
+                 max_rounds: list[int] | None = None):
+        if not exps:
+            raise FleetConfigError("fleet needs >= 1 experiment")
+        for exp in exps:
+            exp.validate()
+        self.params = params or EngineParams()
+        check_uniform(exps, [self.params] * len(exps))
+        check_digest_params(self.params)
+        self.params = self._resolve_fleet_params(self.params)
+        self.exps = list(exps)
+        self.exp = exps[0]
+        self.n_exp = len(exps)
+        self.window = self.exp.window
+        self.n_windows = int(-(-self.exp.end_time // self.window))
+        self.max_rounds = [int(m) for m in
+                           (max_rounds or [self.params.max_rounds]
+                            * self.n_exp)]
+        if len(self.max_rounds) != self.n_exp:
+            raise FleetConfigError(
+                f"max_rounds list ({len(self.max_rounds)}) != experiment "
+                f"count ({self.n_exp})")
+        self._model = _model_module(self.exp.model)
+        self._base_ctx = build_base_ctx(self.exp, self.params,
+                                        window=self.window)
+        self._variants, self._has = self._build_variants()
+        self._base_ctx = dataclasses.replace(
+            self._base_ctx,
+            has_stop=self._has["stop"], has_restart=self._has["restart"],
+            has_link_fault=self._has["link"],
+            has_loss_ramp=self._has["ramp"],
+        )
+        if self._has["restart"]:
+            # Per-experiment restart target: the model pytree exactly as
+            # init() builds it under each lane's constants, captured once
+            # (eager vmap) and carried as a batched variant leaf —
+            # window_step restores restarted hosts' columns from lane e's
+            # capture, same as the solo engine's device constant.
+            cap = jax.vmap(self._lane_init_model)(self._variants)
+            self._variants["init_model"] = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)), cap)
+        self._run_jit = jax.jit(self._make_run())
+
+    # -- construction ------------------------------------------------------
+    def _resolve_fleet_params(self, params: EngineParams) -> EngineParams:
+        if params.auto_caps:
+            raise FleetConfigError(
+                "auto_caps is not available under --fleet: between-chunk "
+                "cap migration is per-lane host-side state surgery, and a "
+                "fleet-wide grow driven by one experiment would change "
+                "every other experiment's cost envelope. Size caps from a "
+                "sweep captune pass instead (tools/captune.py groups "
+                "verdicts per experiment).", kind="mode", knob="auto_caps")
+        if params.on_overflow == "retry":
+            raise FleetConfigError(
+                "on_overflow=retry is not available under --fleet (chunk "
+                "rollback + cap growth is per-lane state surgery); use "
+                "on_overflow=halt — it names the overflowing experiment — "
+                "or size caps with captune.", kind="mode",
+                knob="on_overflow")
+        repl = {}
+        if "pallas" in (params.pop_impl, params.push_impl):
+            import warnings
+
+            warnings.warn("fleet mode runs the XLA pop/push kernels "
+                          "(pallas fused kernels are not vmapped); "
+                          "falling back to pop_impl=push_impl='xla'")
+            repl.update(pop_impl="xla", push_impl="xla")
+        if params.compact_cap:
+            import warnings
+
+            warnings.warn("fleet mode ignores compact_cap: under vmap the "
+                          "compacted and full-width branches would both "
+                          "execute per window, negating the win; running "
+                          "full-width (bit-identical by the compaction "
+                          "contract)")
+            repl.update(compact_cap=0)
+        return dataclasses.replace(params, **repl) if repl else params
+
+    def _build_variants(self) -> tuple[dict, dict]:
+        exps = self.exps
+        variants: dict[str, Any] = {
+            "key": jnp.stack([rng.base_key(e.seed) for e in exps]),
+            "loss_thr_vv": jnp.stack([
+                jnp.asarray(rng.prob_threshold(np.asarray(e.loss_vv)))
+                for e in exps]),
+        }
+        down, up = _stack_host_intervals(exps)
+        variants["fault_down"] = jnp.asarray(down)
+        variants["fault_up"] = jnp.asarray(up)
+        lf = _stack_link_tables(exps)
+        if lf is not None:
+            variants["link_fault"] = tuple(jnp.asarray(a) for a in lf)
+        rt = _stack_ramp_tables(exps)
+        if rt is not None:
+            variants["loss_ramp"] = tuple(jnp.asarray(a) for a in rt)
+        if len(set(self.max_rounds)) > 1:
+            variants["max_rounds"] = jnp.asarray(self.max_rounds, jnp.int32)
+        has = {
+            "stop": bool(down.min() < NO_STOP),
+            "restart": bool((up < NO_STOP).any()),
+            "link": lf is not None,
+            "ramp": rt is not None,
+        }
+        return variants, has
+
+    def _lane_ctx(self, var: dict) -> Ctx:
+        """The solo Ctx lane e would close over, with this lane's variant
+        leaves substituted (traced under vmap)."""
+        params = self._base_ctx.params
+        if "max_rounds" in var:
+            params = dataclasses.replace(params, max_rounds=var["max_rounds"])
+        return dataclasses.replace(
+            self._base_ctx,
+            params=params,
+            key=var["key"],
+            loss_thr_vv=var["loss_thr_vv"],
+            fault_down=var["fault_down"],
+            fault_up=var["fault_up"],
+            link_fault=var.get("link_fault"),
+            loss_ramp=var.get("loss_ramp"),
+            init_model=var.get("init_model"),
+        )
+
+    def _lane_init_model(self, var: dict):
+        ctx = self._lane_ctx(var)
+        model0, _, _ = self._model.init(
+            ctx, evbuf_init(self.exp.n_hosts, self.params.ev_cap))
+        return model0
+
+    # -- state -------------------------------------------------------------
+    def _lane_init_state(self, var: dict) -> SimState:
+        from shadow1_tpu.telemetry.ring import ring_init
+
+        ctx = self._lane_ctx(var)
+        evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
+        model, evbuf, seed_over = self._model.init(ctx, evbuf)
+        metrics = _metrics_init()
+        return SimState(
+            win_start=jnp.zeros((), jnp.int64),
+            evbuf=evbuf,
+            outbox=outbox_init(self.exp.n_hosts, self.params.outbox_cap),
+            model=model,
+            metrics=metrics._replace(
+                ev_overflow=metrics.ev_overflow + seed_over),
+            cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
+            telem=ring_init(self.params.metrics_ring),
+        )
+
+    def init_state(self) -> SimState:
+        return jax.vmap(self._lane_init_state)(self._variants)
+
+    def place_state(self, st: SimState) -> SimState:
+        return jax.device_put(st)
+
+    # -- run ---------------------------------------------------------------
+    def _lane_window_step(self, st: SimState, var: dict) -> SimState:
+        ctx = self._lane_ctx(var)
+        handlers = self._model.make_handlers(ctx)
+        pre = getattr(self._model, "make_pre_window",
+                      lambda c: None)(ctx)
+        return window_step(st, ctx, handlers, pre_window=pre,
+                           make_handlers=self._model.make_handlers)
+
+    def _make_run(self):
+        variants = self._variants
+
+        def run(st: SimState, n_windows) -> SimState:
+            def body(_, s):
+                return jax.vmap(self._lane_window_step)(s, variants)
+
+            return jax.lax.fori_loop(0, n_windows, body, st)
+
+        return run
+
+    def run(self, st: SimState | None = None,
+            n_windows: int | None = None) -> SimState:
+        if st is None:
+            st = self.init_state()
+        n = n_windows if n_windows is not None else self.n_windows
+        return self._run_jit(st, jnp.asarray(n, jnp.int32))
+
+    # -- accessors ---------------------------------------------------------
+    @staticmethod
+    def metrics_per_exp(st: SimState) -> list[dict[str, int]]:
+        return fleet_metrics_per_exp(st)
+
+    @staticmethod
+    def metrics_dict(st: SimState) -> dict[str, int]:
+        """Fleet AGGREGATE: counters sum across experiments, gauges max —
+        keeps generic chunk plumbing (progress display, normalize)
+        working; per-experiment truth is metrics_per_exp."""
+        from shadow1_tpu.telemetry.registry import gauge_names
+
+        gauges = set(gauge_names())
+        out = {}
+        for k, v in st.metrics._asdict().items():
+            a = np.asarray(v)
+            out[k] = int(a.max()) if k in gauges else int(a.sum())
+        # windows advance in lockstep across lanes — report one fleet
+        # window count, not E× it.
+        out["windows"] = int(np.asarray(st.metrics.windows).max())
+        out["rounds"] = int(np.asarray(st.metrics.rounds).max())
+        return out
+
+    def drain_rings(self, st: SimState, start: int = 0) -> list[dict]:
+        return drain_fleet_rings(st, self.window, start=start)
+
+    def model_summary(self, st: SimState, e: int) -> dict[str, Any]:
+        lane = slice_experiment(st, e)
+        return jax.tree.map(
+            np.asarray, self._model.summary(lane.model, self._base_ctx))

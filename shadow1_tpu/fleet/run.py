@@ -1,0 +1,267 @@
+"""Chunked fleet runner — heartbeats, rings, checkpoints, final records.
+
+The fleet twin of ``obs.run_with_heartbeat`` + the CLI's final-JSON
+assembly, built per-experiment from the ground up:
+
+* the telemetry ring drains PER EXPERIMENT (``type: "ring"`` records with
+  an ``exp`` field — the per-window series and digest words of lane e are
+  exactly a solo run's, docs/OBSERVABILITY.md §"Fleet records");
+* heartbeats carry the fleet-aggregate deltas plus a compact per-
+  experiment events vector (one record per chunk, not E);
+* ``--on-overflow halt`` and ``--selfcheck`` run their boundary checks
+  per experiment — a CapacityExceededError names the experiment (and its
+  seed) whose cap overflowed;
+* checkpoints snapshot the WHOLE fleet state (one .npz, every leaf with
+  its leading [E] axis) at heartbeat boundaries, same atomic write +
+  progress sidecar as the solo path — a resumed fleet continues
+  bit-identically, and ``fleet.engine.slice_experiment`` extracts any one
+  lane as a solo-resumable state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from shadow1_tpu.consts import SEC
+from shadow1_tpu.telemetry.registry import DROP_FIELDS, normalize
+
+
+class FleetHeartbeat:
+    """Per-chunk fleet heartbeat: aggregate deltas + per-experiment events.
+
+    One record per chunk boundary (type ``heartbeat`` with a ``fleet``
+    block), so existing consumers (tools/heartbeat_report.py) read the
+    aggregate series unchanged while fleet-aware ones use the block."""
+
+    def __init__(self, engine, stream=None, initial_state=None,
+                 emit_heartbeat=True, emit_ring=True):
+        self.engine = engine
+        self.stream = stream if stream is not None else sys.stderr
+        self.emit_heartbeat = emit_heartbeat
+        self.emit_ring = emit_ring
+        self.t_start = time.perf_counter()
+        self.t_last = self.t_start
+        self.last = (normalize(engine.metrics_dict(initial_state))
+                     if initial_state is not None else {})
+        self.last_per_exp = (engine.metrics_per_exp(initial_state)
+                             if initial_state is not None else None)
+        self._ring_next = self.last.get("windows", 0)
+        self.records: list[dict] = []
+        self.ring_records: list[dict] = []
+
+    def _emit(self, rec: dict) -> None:
+        if self.stream:
+            print(json.dumps(rec), file=self.stream, flush=True)
+
+    def __call__(self, st, done_windows: int, per_exp=None) -> None:
+        now = time.perf_counter()
+        m = normalize(self.engine.metrics_dict(st))
+        # The chunk runner already fetched the per-experiment dicts for its
+        # halt/selfcheck boundary checks — reuse them, don't re-sync.
+        if per_exp is None:
+            per_exp = self.engine.metrics_per_exp(st)
+        ring_recs = self.engine.drain_rings(st, start=self._ring_next)
+        self._ring_next = m.get("windows", 0)
+        delta = {k: v - self.last.get(k, 0) for k, v in m.items()
+                 if isinstance(v, int)}
+        dt = now - self.t_last
+        d_windows = delta.get("windows", 0)
+        ev_per_exp = [int(d["events"]) for d in per_exp]
+        if self.last_per_exp is not None:
+            ev_per_exp = [e - int(l["events"]) for e, l in
+                          zip(ev_per_exp, self.last_per_exp)]
+        rec = {
+            "type": "heartbeat",
+            "sim_time_s": round(int(np.asarray(st.win_start).max()) / SEC, 6),
+            "wall_s": round(now - self.t_start, 3),
+            "windows": done_windows,
+            "events_per_sec": round(delta.get("events", 0) / dt, 1)
+            if dt > 0 else None,
+            "rounds_per_window": round(delta.get("rounds", 0) / d_windows, 2)
+            if d_windows else None,
+            "delta": delta,
+            "fleet": {
+                "experiments": self.engine.n_exp,
+                "events_per_exp": ev_per_exp,
+            },
+        }
+        drops = {f: delta.pop(f, 0) for f in DROP_FIELDS}
+        rec["drops"] = {"total": sum(drops.values()), **drops}
+        self.records.append(rec)
+        if self.emit_heartbeat:
+            self._emit(rec)
+        for r in ring_recs:
+            self.ring_records.append(r)
+            if self.emit_ring:
+                self._emit(r)
+        self.t_last = now
+        self.last = m
+        self.last_per_exp = per_exp
+
+
+def _check_halt(engine, plan_labels, per_exp, prev_per_exp, done, step):
+    """Per-experiment overflow halt: the first lane with fresh overflow
+    raises a CapacityExceededError that names it."""
+    from shadow1_tpu.txn import CapacityExceededError
+    from shadow1_tpu.tune.ladder import recommend_cap
+
+    checks = (("ev_overflow", "ev_cap", "ev_max_fill"),
+              ("ob_overflow", "outbox_cap", "ob_max_fill"))
+    for e, m in enumerate(per_exp):
+        prev = prev_per_exp[e] if prev_per_exp else {}
+        for counter, knob, gauge in checks:
+            fresh = int(m.get(counter, 0)) - int(prev.get(counter, 0))
+            if fresh > 0:
+                label = plan_labels[e] if plan_labels else {"exp": e}
+                gv = int(m.get(gauge, 0))
+                raise CapacityExceededError(
+                    knob=knob, counter=counter,
+                    cap=getattr(engine.params, knob), overflow=fresh,
+                    window_range=(done, done + step),
+                    recommended=recommend_cap(gv) if gv else None,
+                    detail=(f" (fleet experiment {label.get('exp', e)}, "
+                            f"seed {label.get('seed', '?')})"),
+                    # The solo remedies (--on-overflow retry / --auto-caps)
+                    # are themselves rejected under --fleet — advise only
+                    # what works there.
+                    remedy=("(--on-overflow retry and --auto-caps are not "
+                            "available under --fleet; caps are "
+                            "fleet-uniform) — or size the whole sweep from "
+                            "a recorded run: python -m "
+                            "shadow1_tpu.tools.captune <run.log>"),
+                )
+
+
+def run_fleet(engine, st=None, n_windows=None, every_windows=None,
+              stream=None, ckpt_path=None, ckpt_every_s=120.0,
+              emit_heartbeat=True, emit_ring=True, selfcheck=False,
+              labels=None):
+    """Run the fleet in chunks. Returns (final_state, FleetHeartbeat).
+
+    Mirrors ``obs.run_with_heartbeat``: compile excluded from the first
+    chunk's rate, checkpoints throttled to ``ckpt_every_s`` with the
+    ``.progress`` sidecar the supervisor reads, per-experiment halt /
+    selfcheck boundary checks."""
+    import jax
+
+    from shadow1_tpu import ckpt as _ckpt
+
+    total = n_windows if n_windows is not None else engine.n_windows
+    if every_windows is None:
+        every_windows = max(total // 10, 1)
+    if st is None:
+        st = engine.init_state()
+    jax.block_until_ready(engine.run(st, n_windows=0))
+    hb = FleetHeartbeat(engine, stream=stream, initial_state=st,
+                        emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
+    halt = engine.params.on_overflow == "halt"
+    prev_per_exp = engine.metrics_per_exp(st)
+    last_save = time.perf_counter()
+    last_done = [0]
+
+    def on_chunk(s, done):
+        nonlocal prev_per_exp
+        step = done - last_done[0]
+        last_done[0] = done
+        per_exp = engine.metrics_per_exp(s)
+        if halt:
+            _check_halt(engine, labels, per_exp, prev_per_exp,
+                        done - step, step)
+        if selfcheck:
+            from shadow1_tpu.txn import check_boundary_identity
+
+            for e, m in enumerate(per_exp):
+                check_boundary_identity(
+                    m, where=(f"fleet experiment {e}, chunk boundary, "
+                              f"window {m.get('windows', 0)}"))
+        prev_per_exp = per_exp
+        hb(s, done, per_exp=per_exp)
+        sim_ns = int(np.asarray(s.win_start).max())
+        # Fault-injection hooks, same contract as obs.run_with_heartbeat:
+        # die like a wedged device process at an exact sim time (pre- or
+        # post-save flavor) so the supervisor path is testable fleet-shaped
+        # too. Inert without the env vars.
+        crash_pre = os.environ.get("SHADOW1_OBS_CRASH_PRE_SAVE_AT_NS")
+        if crash_pre is not None and sim_ns == int(crash_pre):
+            os._exit(41)
+        nonlocal last_save
+        now = time.perf_counter()
+        if ckpt_path and (done >= total or now - last_save > ckpt_every_s):
+            _ckpt.save_state(s, ckpt_path)
+            tmp = ckpt_path + ".progress.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"done_windows": done, "total": total,
+                           "win_start": sim_ns}, f)
+            os.replace(tmp, ckpt_path + ".progress")
+            last_save = now
+            crash_at = os.environ.get("SHADOW1_OBS_CRASH_AT_NS")
+            if crash_at is not None and sim_ns == int(crash_at):
+                os._exit(41)
+
+    st = _ckpt.run_chunked(engine, st, n_windows=total, chunk=every_windows,
+                           on_chunk=on_chunk)
+    return st, hb
+
+
+def final_records(engine, st, labels, n_windows, wall, resumed=False,
+                  metrics0=None):
+    """The CLI's end-of-run output: one ``fleet_exp`` record per
+    experiment plus one ``fleet_summary`` — schemas in
+    docs/OBSERVABILITY.md §"Fleet records". ``metrics0`` (per-exp dicts
+    from a resumed snapshot) baselines rates to THIS invocation like the
+    solo CLI."""
+    per_exp = engine.metrics_per_exp(st)
+    params = engine.params
+    caps = {"ev_cap": params.ev_cap, "outbox_cap": params.outbox_cap,
+            "compact_cap": params.compact_cap}
+    sim_s = n_windows * engine.window / 1e9
+    recs = []
+    ev_run_total = 0
+    for e, m in enumerate(per_exp):
+        label = labels[e] if labels else {"exp": e}
+        ev0 = metrics0[e].get("events", 0) if metrics0 else 0
+        ev_run = m["events"] - ev0
+        ev_run_total += ev_run
+        drops = {f: int(m.get(f, 0)) for f in DROP_FIELDS}
+        rec = {
+            "type": "fleet_exp",
+            **label,
+            "engine": "fleet",
+            "hosts": engine.exp.n_hosts,
+            "window_ns": engine.window,
+            "windows": n_windows,
+            "caps": caps,
+            "metrics": m,
+            "drops": {"total": sum(drops.values()), **drops},
+        }
+        restarts = int(m.get("host_restarts", 0))
+        fault_drops = {k: drops[k] for k in
+                       ("down_events", "down_pkts", "link_down_pkts")}
+        if restarts or any(fault_drops.values()):
+            rec["faults"] = {"host_restarts": restarts, **fault_drops}
+        recs.append(rec)
+    agg = engine.metrics_dict(st)
+    summary = {
+        "type": "fleet_summary",
+        "engine": "fleet",
+        "experiments": engine.n_exp,
+        "hosts": engine.exp.n_hosts,
+        "window_ns": engine.window,
+        "windows": n_windows,
+        "sim_seconds": round(sim_s, 6),
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(sim_s / wall, 3) if wall > 0 else None,
+        # Aggregate sweep throughput — the fleet-mode headline: events
+        # executed across ALL experiments per wall second.
+        "events_per_sec": round(ev_run_total / wall, 1) if wall > 0 else None,
+        "events_per_exp": [int(m["events"]) for m in per_exp],
+        "resumed": bool(resumed),
+        "caps": caps,
+        "metrics": agg,
+    }
+    return recs, summary

@@ -84,14 +84,20 @@ HOST_FIELDS = ("chunk_retries", "retry_windows_rerun")
 
 # JSONL record types every consumer recognises (docs/OBSERVABILITY.md).
 # ``digest`` is the CPU oracle's per-window state-digest row (the batched
-# engines carry the same words as ring columns instead).
+# engines carry the same words as ring columns instead). Fleet mode
+# (shadow1_tpu/fleet/) emits one ``fleet_exp`` final record per experiment
+# plus one ``fleet_summary``; its ring records are the solo schema with an
+# added ``exp`` experiment-id field — consumers group by it and keep it out
+# of any value math.
 REC_HEARTBEAT = "heartbeat"
 REC_TRACKER = "tracker"
 REC_RING = "ring"
 REC_RING_GAP = "ring_gap"
 REC_DIGEST = "digest"
+REC_FLEET_EXP = "fleet_exp"
+REC_FLEET_SUMMARY = "fleet_summary"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
-                REC_DIGEST)
+                REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY)
 
 # The drop/overflow counter group: every way a modeled event or packet can
 # be discarded, with the human-readable reason. Heartbeat records and the

@@ -68,10 +68,22 @@ def load_records(paths: list[str]) -> list[dict]:
 def group_records(recs: list[dict]) -> dict[str, list[dict]]:
     """Partition records by the config they measured (occprobe rows carry
     ``config``; a single run's ring/heartbeat/final records do not and land
-    in one shared group) — peaks must never aggregate across configs."""
+    in one shared group) — peaks must never aggregate across configs.
+
+    Fleet records (ring rows / ``fleet_exp`` finals with an ``exp``
+    experiment id) further partition per experiment: a sweep's cap
+    verdicts come out one per experiment, and one lane's occupancy can
+    never inflate another's recommendation. The experiment id is purely a
+    grouping key — it enters no peak/percentile math. Records WITHOUT an
+    ``exp`` field from a fleet log (the aggregate heartbeat /
+    ``fleet_summary``) land in the shared base group, whose gauges are
+    fleet maxima — per-experiment truth stays in the exp groups."""
     groups: dict[str, list[dict]] = {}
     for r in recs:
-        groups.setdefault(str(r.get("config", "(run)")), []).append(r)
+        key = str(r.get("config", "(run)"))
+        if isinstance(r.get("exp"), int):
+            key += f" [exp {r['exp']}]"
+        groups.setdefault(key, []).append(r)
     return groups
 
 

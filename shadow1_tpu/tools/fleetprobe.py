@@ -1,0 +1,169 @@
+"""Fleet↔solo digest-parity verifier — the fleet contract, live.
+
+    python -m shadow1_tpu.tools.fleetprobe sweep.yaml [--sides tpu,cpu]
+        [--windows N] [--exps 0,2] [--json-only]
+
+Expands the config's ``sweep:`` section, runs the WHOLE fleet as one
+vmapped program with the determinism flight recorder on, then runs each
+experiment ALONE on the requested sides (``tpu`` = the solo batched
+engine, ``cpu`` = the eager oracle) and asserts the per-window digest
+streams are bit-identical per experiment: lane e of the fleet must be
+indistinguishable from running experiment e by itself
+(docs/SEMANTICS.md §"Fleet contract").
+
+Exit codes follow tools/paritytrace.py: 0 = parity, 3 = divergence (the
+last stdout line is a JSON verdict either way). On a mismatch the verdict
+names the first divergent (experiment, side, window, subsystems) — feed
+that experiment's config to paritytrace for the per-slot plane diff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+EXIT_DIVERGED = 3
+
+
+def _ring_digest_stream(st, window_ns: int) -> dict[int, tuple]:
+    from shadow1_tpu.core.digest import SUBSYSTEMS
+    from shadow1_tpu.telemetry.ring import drain_ring
+
+    return {
+        r["window"]: tuple(r[f"dg_{s}"] for s in SUBSYSTEMS)
+        for r in drain_ring(st, window_ns)
+        if r["type"] == "ring"
+    }
+
+
+def _first_mismatch(fleet: dict, solo: dict) -> dict | None:
+    from shadow1_tpu.core.digest import SUBSYSTEMS
+
+    if sorted(fleet) != sorted(solo):
+        return {"window": None,
+                "reason": f"window sets differ (fleet {len(fleet)}, "
+                          f"solo {len(solo)})"}
+    for w in sorted(fleet):
+        if fleet[w] != solo[w]:
+            subs = [s for s, a, b in
+                    zip(SUBSYSTEMS, fleet[w], solo[w]) if a != b]
+            return {"window": w, "subsystems": subs}
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.fleetprobe")
+    ap.add_argument("config", help="YAML experiment file with a sweep: "
+                                   "section")
+    ap.add_argument("--sides", default="tpu,cpu",
+                    help="comma list of solo sides to compare each fleet "
+                         "lane against: tpu (solo batched engine), cpu "
+                         "(eager oracle). Default both.")
+    ap.add_argument("--windows", type=int, default=None,
+                    help="compare only this many windows (default: the "
+                         "configured run, capped at 200)")
+    ap.add_argument("--exps", default=None,
+                    help="comma list of experiment indices to solo-check "
+                         "(default: all)")
+    ap.add_argument("--json-only", action="store_true",
+                    help="suppress progress lines; print only the verdict")
+    args = ap.parse_args(argv)
+
+    import dataclasses
+
+    import shadow1_tpu  # noqa: F401  (x64 before jax arrays)
+    from shadow1_tpu.fleet.expand import FleetConfigError, load_sweep
+
+    def config_exit(e: FleetConfigError) -> int:
+        print(f"fleetprobe: {e}", file=sys.stderr)
+        print(json.dumps({"ok": False, "error": "fleet_config",
+                          "kind": e.kind, "knob": e.knob,
+                          "message": str(e)}))
+        return 2
+
+    try:
+        plan = load_sweep(args.config)
+    except FleetConfigError as e:
+        return config_exit(e)
+    windows = args.windows
+    sides = [s.strip() for s in args.sides.split(",") if s.strip()]
+
+    def say(msg):
+        if not args.json_only:
+            print(msg, file=sys.stderr, flush=True)
+
+    from shadow1_tpu.core.engine import Engine
+    from shadow1_tpu.fleet.engine import FleetEngine, slice_experiment
+
+    # Digest transport: a ring deep enough to hold the whole compared run.
+    n_total = int(-(-plan.exps[0].end_time // plan.exps[0].window))
+    if windows is None:
+        windows = min(n_total, 200)
+    params = dataclasses.replace(plan.params, state_digest=1,
+                                 metrics_ring=max(windows, 1))
+
+    try:
+        fleet = FleetEngine(plan.exps, params, plan.max_rounds)
+    except FleetConfigError as e:
+        # Mode rejections raised at engine construction (auto_caps /
+        # on_overflow=retry in the config's engine: section) keep the
+        # same "last stdout line is a JSON verdict" contract.
+        return config_exit(e)
+    say(f"[fleetprobe] fleet: {fleet.n_exp} experiments x {windows} "
+        f"windows, {fleet.exp.n_hosts} hosts")
+    stf = fleet.run(n_windows=windows)
+    fleet_streams = [
+        _ring_digest_stream(slice_experiment(stf, e), fleet.window)
+        for e in range(fleet.n_exp)
+    ]
+
+    exp_ids = (range(fleet.n_exp) if args.exps is None
+               else [int(x) for x in args.exps.split(",")])
+    compared = {s: 0 for s in sides}
+    mismatches = []
+    for e in exp_ids:
+        exp = plan.exps[e]
+        p_e = dataclasses.replace(params, max_rounds=plan.max_rounds[e])
+        for side in sides:
+            if side == "tpu":
+                eng = Engine(exp, p_e)
+                solo = _ring_digest_stream(eng.run(n_windows=windows),
+                                           eng.window)
+            elif side == "cpu":
+                from shadow1_tpu.cpu_engine import CpuEngine
+
+                cpu = CpuEngine(exp, p_e)
+                cpu.run(n_windows=windows)
+                from shadow1_tpu.core.digest import SUBSYSTEMS
+
+                solo = {
+                    r["window"]: tuple(r[f"dg_{s}"] for s in SUBSYSTEMS)
+                    for r in cpu.digest_rows
+                }
+            else:
+                raise SystemExit(f"unknown side {side!r} (tpu|cpu)")
+            mm = _first_mismatch(fleet_streams[e], solo)
+            if mm is None:
+                compared[side] += 1
+                say(f"[fleetprobe] exp {e} vs solo {side}: "
+                    f"{len(solo)} windows bit-identical")
+            else:
+                mismatches.append({"exp": e, "side": side, **mm})
+                say(f"[fleetprobe] exp {e} vs solo {side}: DIVERGED {mm}")
+
+    verdict = {
+        "ok": not mismatches,
+        "experiments": fleet.n_exp,
+        "solo_checked": list(exp_ids),
+        "windows": windows,
+        "sides": sides,
+        "streams_compared": compared,
+        "mismatches": mismatches,
+    }
+    print(json.dumps(verdict))
+    return 0 if not mismatches else EXIT_DIVERGED
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

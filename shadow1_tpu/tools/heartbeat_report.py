@@ -21,6 +21,7 @@ import sys
 
 from shadow1_tpu.telemetry.registry import (
     DROP_SPECS,
+    REC_FLEET_EXP,
     REC_HEARTBEAT,
     REC_RING,
     REC_RING_GAP,
@@ -82,11 +83,25 @@ def summarize(recs: list[dict], out=None) -> dict:
     tr = [r for r in recs if r.get("type") == REC_TRACKER]
     rings = [r for r in recs if r.get("type") == REC_RING]
     gaps = [r for r in recs if r.get("type") == REC_RING_GAP]
+    fleet_exp = [r for r in recs if r.get("type") == REC_FLEET_EXP]
     summary: dict = {
         "heartbeats": len(hb),
         "tracker_records": len(tr),
         "ring_records": len(rings),
     }
+    if fleet_exp:
+        # Fleet final records: one row per experiment (events, drops,
+        # restarts) — the sweep's result table.
+        summary["fleet_experiments"] = len(fleet_exp)
+        print("== fleet experiments ==", file=out)
+        for r in sorted(fleet_exp, key=lambda r: r.get("exp", 0)):
+            m = r.get("metrics", {})
+            drops = r.get("drops", {})
+            print(f"  exp {r.get('exp')}: seed {r.get('seed')}  "
+                  f"events {m.get('events')}  "
+                  f"delivered {m.get('pkts_delivered')}  "
+                  f"drops {drops.get('total', 0)}  "
+                  f"restarts {m.get('host_restarts', 0)}", file=out)
     if hb:
         eps = [r["events_per_sec"] for r in hb if r.get("events_per_sec")]
         spw = [r["sim_per_wall"] for r in hb if r.get("sim_per_wall")]
@@ -149,20 +164,48 @@ def summarize(recs: list[dict], out=None) -> dict:
                                  for k, v in last["caps"].items())
                 print(f"  final caps: {caps}", file=out)
     if rings:
-        rs = ring_summary(rings)
-        summary["ring"] = rs
-        print("== per-window occupancy (ring) ==", file=out)
-        print(f"  windows recorded: {rs['windows']}", file=out)
+        # Fleet runs tag each ring row with its experiment id (``exp``):
+        # group the per-window stats PER EXPERIMENT — mixing lanes would
+        # blend E unrelated distributions into one meaningless percentile.
+        # The id itself is a grouping key only; it never enters the math
+        # (only RING_COUNTERS/RING_GAUGES rank in ring_summary).
+        by_exp: dict = {}
+        for r in rings:
+            by_exp.setdefault(r.get("exp"), []).append(r)
+        # Gap records carry the same per-experiment tag: losses attribute
+        # to their own lane, never summed into another's section.
+        gaps_by_exp: dict = {}
+        for g in gaps:
+            gaps_by_exp.setdefault(g.get("exp"), []).append(g)
         if gaps:
-            lost = sum(g.get("windows_lost", 0) for g in gaps)
-            summary["ring_windows_lost"] = lost
-            print(f"  WINDOWS LOST TO RING OVERWRITE: {lost} "
-                  f"(chunk exceeded ring depth)", file=out)
-        for field in RING_FIELDS:
-            if field in rs:
-                d = rs[field]
-                print(f"  {field}: p50 {d['p50']}  p95 {d['p95']}  "
-                      f"max {d['max']}", file=out)
+            summary["ring_windows_lost"] = sum(
+                g.get("windows_lost", 0) for g in gaps)
+        if set(by_exp) == {None}:
+            groups = [(None, rings)]
+        else:
+            groups = sorted(by_exp.items(),
+                            key=lambda kv: (kv[0] is None, kv[0]))
+            summary["ring_experiments"] = len(groups)
+        for exp_id, group in groups:
+            rs = ring_summary(group)
+            if exp_id is None:
+                summary["ring"] = rs
+                print("== per-window occupancy (ring) ==", file=out)
+            else:
+                summary.setdefault("ring_by_exp", {})[exp_id] = rs
+                print(f"== per-window occupancy (ring, experiment "
+                      f"{exp_id}) ==", file=out)
+            print(f"  windows recorded: {rs['windows']}", file=out)
+            lane_lost = sum(g.get("windows_lost", 0)
+                            for g in gaps_by_exp.get(exp_id, []))
+            if lane_lost:
+                print(f"  WINDOWS LOST TO RING OVERWRITE: {lane_lost} "
+                      f"(chunk exceeded ring depth)", file=out)
+            for field in RING_FIELDS:
+                if field in rs:
+                    d = rs[field]
+                    print(f"  {field}: p50 {d['p50']}  p95 {d['p95']}  "
+                          f"max {d['max']}", file=out)
     # Capacity advisory (tools/captune.py): measured peaks vs the caps the
     # records carry — the actionable line the cap-sizing debates need.
     from shadow1_tpu.tools import captune

@@ -73,7 +73,7 @@ class CapacityExceededError(RuntimeError):
 
     def __init__(self, knob: str, counter: str, cap: int, overflow: int,
                  window_range: tuple[int, int], recommended: int | None = None,
-                 detail: str = ""):
+                 detail: str = "", remedy: str | None = None):
         self.knob = knob
         self.counter = counter
         self.cap = int(cap)
@@ -92,9 +92,10 @@ class CapacityExceededError(RuntimeError):
             f"layout-defined, so the run has forked from its big-cap truth "
             f"(docs/SEMANTICS.md 'Capacities'). Paste-ready fix:\n"
             f"{self.advice}\n"
-            f"or rerun with --on-overflow retry (transactional grow+replay) "
-            f"/ --auto-caps; size precisely from a recorded run: "
-            f"python -m shadow1_tpu.tools.captune <run.log>"
+            + (remedy if remedy is not None else
+               "or rerun with --on-overflow retry (transactional "
+               "grow+replay) / --auto-caps; size precisely from a recorded "
+               "run: python -m shadow1_tpu.tools.captune <run.log>")
         )
 
 
