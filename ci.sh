@@ -26,7 +26,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_preempt.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -145,6 +145,57 @@ print("fleetprobe: 3 experiments x", d["windows"],
       "windows bit-identical fleet<->solo on tpu and cpu sides")
 '
     rm -f "$fl_cfg"
+    echo "== preemption smoke (SIGTERM drain + kill-anywhere chaos trials) =="
+    # SIGTERM mid-run must commit the in-flight chunk, write a final
+    # snapshot and exit the documented preempted code (consts.py taxonomy);
+    # rerunning the same command must resume, not restart.
+    pre_ck=$(mktemp -u /tmp/shadow1_pre_XXXX.npz)
+    window_ns=$(JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
+import shadow1_tpu
+from shadow1_tpu.config.experiment import load_experiment
+print(load_experiment("configs/rung1_filexfer.yaml")[0].window)')
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SHADOW1_SUPERVISE_BACKOFF_S=0 \
+        SHADOW1_OBS_SIGTERM_SELF_AT_NS=$((20 * window_ns)) \
+        python -m shadow1_tpu configs/rung1_filexfer.yaml --windows 40 \
+        --heartbeat 10 --ckpt-every-s 0 --ckpt "$pre_ck" \
+        >/tmp/_pre_drain.out 2>/dev/null && rc=0 || rc=$?
+    exp_rc=$(python -c 'from shadow1_tpu.consts import EXIT_PREEMPTED; print(EXIT_PREEMPTED)')
+    [ "$rc" -eq "$exp_rc" ] || { echo "drain: expected EXIT_PREEMPTED=$exp_rc, got $rc" >&2; exit 1; }
+    python -c '
+import json
+rec = json.loads(open("/tmp/_pre_drain.out").read().strip().splitlines()[-1])
+assert rec["preempted"] is True and rec["signal"] == "SIGTERM", rec
+assert rec["win_start"] > 0, rec
+print("drain: EXIT_PREEMPTED with parseable record at sim_ns", rec["win_start"])
+'
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SHADOW1_SUPERVISE_BACKOFF_S=0 \
+        python -m shadow1_tpu configs/rung1_filexfer.yaml --windows 40 \
+        --heartbeat 10 --ckpt-every-s 0 --ckpt "$pre_ck" \
+        >/tmp/_pre_resume.out 2>/dev/null
+    python -c '
+import json
+out = json.loads(open("/tmp/_pre_resume.out").read().strip().splitlines()[-1])
+assert out["resumed"] is True, out
+print("drain: rerun resumed from the preemption snapshot")
+'
+    rm -f /tmp/_pre_drain.out /tmp/_pre_resume.out "$pre_ck"*
+    # Kill-anywhere chaos trials (tools/chaosprobe.py): the first three
+    # trial kinds are the deterministic special ones — a mid-run SIGTERM
+    # drain, a torn-head mid-checkpoint-write kill, and a corrupt-head
+    # lineage fallback — each must end bit-identical to the straight run.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.chaosprobe \
+        configs/rung1_filexfer.yaml --windows 40 --chunk 10 --trials 3 \
+        --seed 1 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+assert d["trials"] == 3, d
+assert d["preempted_exits"] >= 1, d
+assert d["lineage_fallbacks"] >= 1, d
+print("chaosprobe:", d["trials"], "kill trials bit-identical;",
+      d["preempted_exits"], "drain(s),", d["lineage_fallbacks"],
+      "lineage fallback(s)")
+'
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
 import tempfile, os

@@ -271,7 +271,7 @@ def snapshot_caps(template, path: str) -> tuple[int, int] | None:
 
 def run_chunked(engine, st=None, n_windows: int | None = None,
                 chunk: int = 0, on_chunk=None, profiler=None, retune=None,
-                guard=None, selfcheck: bool = False):
+                guard=None, selfcheck: bool = False, drain=None):
     """Run in fixed-size window chunks, invoking ``on_chunk(st, done)`` after
     each (for checkpoints/heartbeats). One compiled program is reused for
     every full chunk. Returns the final state.
@@ -297,7 +297,14 @@ def run_chunked(engine, st=None, n_windows: int | None = None,
 
     ``selfcheck`` (CLI ``--selfcheck``) verifies the drop-accounting
     identity on every committed chunk boundary (txn.SelfCheckError on
-    violation) — churnprobe's probe-only invariant, guarding every run."""
+    violation) — churnprobe's probe-only invariant, guarding every run.
+
+    ``drain`` (preempt.DrainHandler) is the signal plane: when a
+    SIGTERM/SIGINT has requested a drain, the loop finishes the in-flight
+    chunk, commits it, lets ``on_chunk`` run (which forces the final
+    snapshot when the run carries a checkpoint path) and raises
+    preempt.PreemptedExit — checked only at chunk boundaries, never inside
+    a window (a window is the atomic unit of the determinism contract)."""
     from shadow1_tpu.telemetry import PH_INIT, PH_RUN_CHUNK, maybe_span
 
     if st is None:
@@ -333,8 +340,20 @@ def run_chunked(engine, st=None, n_windows: int | None = None,
             check_boundary_identity(
                 type(engine).metrics_dict(st),
                 where=f"chunk boundary, window {int(st.metrics.windows)}")
+        # Sample the drain latch BEFORE on_chunk: on_chunk's forced-save
+        # check can only see the latch as MORE set than this sample, so
+        # whenever we raise below, the final snapshot was already forced —
+        # a signal landing mid-on_chunk is honored one boundary later,
+        # never honored without its snapshot.
+        draining = drain is not None and drain.requested and done < total
         if on_chunk is not None:
             on_chunk(st, done)
+        if draining:
+            from shadow1_tpu.preempt import PreemptedExit
+
+            raise PreemptedExit(
+                st=st, signame=drain.signame, done_windows=done,
+                win_start=int(np.asarray(st.win_start).max()))
         if retune is not None and done < total:
             engine, st = retune(engine, st)
             if guard is not None:

@@ -17,9 +17,16 @@ import time
 
 MAX_RESPAWNS = 8
 
-# Exit code for a CapacityExceededError halt (shadow1_tpu/txn.py) — kept in
-# sync by the import below; duplicated as a literal nowhere.
-from shadow1_tpu.txn import EXIT_CAPACITY  # noqa: E402 (jax-free module)
+# The CLI exit-code taxonomy lives in consts.py (jax-free) — 0 ok, 2 config,
+# 4 capacity halt, 5 preempted drain, 6 watchdog-classified hang; duplicated
+# as literals nowhere (docs/SEMANTICS.md "Preemption contract", README).
+from shadow1_tpu.consts import (  # noqa: E402 (jax-free module)
+    EXIT_CAPACITY,
+    EXIT_CONFIG,
+    EXIT_HUNG,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+)
 
 
 def _config_fingerprint(config_path: str) -> str:
@@ -32,7 +39,42 @@ def _config_fingerprint(config_path: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
-def _supervise(child_argv, ckpt_path, config_path) -> int:
+def _emit_resume_record(ckpt_path, resolved, win_start, lineage=None) -> None:
+    """One parseable ``resume`` record on stderr per lineage resume (schema
+    in docs/OBSERVABILITY.md): which generation the run continued from,
+    how many corrupt newer generations were skipped, and the lineage depth
+    on disk — the rows heartbeat_report's lineage section summarizes."""
+    rec = {"type": "resume", "ckpt": ckpt_path,
+           "generation": resolved.seq, "win_start": int(win_start),
+           "fallback_skipped": len(resolved.skipped)}
+    if resolved.skipped:
+        rec["discarded"] = [s["file"] for s in resolved.skipped]
+    if lineage is not None:
+        rec["generations_kept"] = len(lineage.generations())
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def _resolve_ckpt_lineage(args, log, what="checkpoint"):
+    """Child-side resume resolution, shared by the solo and fleet paths:
+    walk the --ckpt lineage to the newest VALID generation (deleting
+    corrupt newer ones), warn when nothing verifies, and fall back to an
+    explicit --resume. Returns (resolved, lineage, resume_path)."""
+    if not args.ckpt:
+        return None, None, args.resume
+    from shadow1_tpu.lineage import Lineage
+
+    lineage = Lineage(args.ckpt, keep=args.ckpt_keep)
+    r = lineage.resolve(discard_invalid=True)
+    resolved = r if (r is not None and r.path is not None) else None
+    if r is not None and resolved is None:
+        log.warning(f"discarding corrupt {what}", path=args.ckpt,
+                    reason=(r.skipped[0]["reason"] if r.skipped
+                            else "no valid generation"))
+    return resolved, lineage, (resolved.path if resolved else args.resume)
+
+
+def _supervise(child_argv, ckpt_path, config_path,
+               watchdog_s: float = 0.0) -> int:
     """Parent side of ``--ckpt`` fault tolerance (the ladder's recipe,
     bench_ladder.py): run the CLI in a child process; when it dies with a
     checkpoint showing forward progress, respawn a fresh child that resumes
@@ -41,29 +83,61 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
 
     Failure handling beyond the bare respawn loop:
 
-    * **corrupt checkpoints** are verified host-side (ckpt.verify_file)
-      before every spawn and discarded like stale ones — the child restarts
-      from scratch instead of crash-looping on a bit-flipped snapshot;
+    * **checkpoint lineage** (lineage.Lineage): the generation set is
+      resolved host-side before every spawn — a corrupt HEAD with a valid
+      older generation behind it is announced and left for the child to
+      fall back on (one generation of progress lost, not the run); only
+      when NO generation verifies is the whole set discarded and the run
+      restarted from scratch;
+    * **preemption** (rc == EXIT_PREEMPTED): a SIGTERM/SIGINT drain is a
+      clean-resume exit, not a crash — no backoff, no crash accounting,
+      checkpoint kept; the supervisor exits EXIT_PREEMPTED itself so the
+      operator (or the next scheduler slot) reruns the same command to
+      continue. The supervisor forwards its own SIGTERM/SIGINT to the
+      child so signaling either process drains the run.
+    * **watchdog** (``--watchdog-s`` / env SHADOW1_WATCHDOG_S): a child
+      whose ``.progress`` sidecar mtime goes stale past the deadline is
+      killed and classified **hung** — distinct from crashed, with its own
+      backoff lane; two consecutive hangs without forward progress abort
+      with EXIT_HUNG and point at the no-kill probe playbook
+      (tools/faultprobe) — a dead tunnel costs a bounded delay, never an
+      unbounded one (the PR 3/4 postmortems recorded 150 s silently lost);
     * **exponential backoff**: the respawn delay doubles on consecutive
-      no-progress crashes and resets when a crash follows forward progress
-      (env SHADOW1_SUPERVISE_BACKOFF_S tunes the base; tests set 0);
+      no-progress failures (per lane) and resets when an attempt makes
+      forward progress (env SHADOW1_SUPERVISE_BACKOFF_S tunes the base;
+      tests set 0);
     * **failure classification**: two consecutive crashes at the same
       ``win_start`` mean the fault is deterministic at that sim time — a
       third identical attempt would burn the respawn budget for nothing,
       so the supervisor aborts with a diagnosis instead.
     """
     import os
+    import signal
     import subprocess
     import time as _time
 
+    from shadow1_tpu.lineage import Lineage, write_json_atomic
+    from shadow1_tpu.preempt import FORCE_GRACE_S
+
     sidecar = ckpt_path + ".progress"
     meta_path = ckpt_path + ".meta"
+    lineage = Lineage(ckpt_path)
+
+    def _emit_lineage(event: str, **fields) -> None:
+        # Parseable lineage records on stderr, beside the [supervise] prose
+        # — tools/heartbeat_report.py's "lineage" section reads these.
+        print(json.dumps({"type": "lineage", "event": event, **fields}),
+              file=sys.stderr, flush=True)
+
     # A snapshot left by an earlier interrupted run of a DIFFERENT config
     # must not silently hijack this run (same leaf shapes would pass
     # load_state's checks): fingerprint-mismatched leftovers are deleted.
     fp = _config_fingerprint(config_path)
     stale = False
-    if os.path.exists(ckpt_path):
+    # ANY lineage candidate counts — a kill between head-rotation and
+    # install leaves rotated generations with no head, and those must not
+    # hijack a different config's run any more than a head would.
+    if any(os.path.exists(p) for p in lineage.sidecar_paths()):
         try:
             with open(meta_path) as f:
                 stale = json.load(f).get("config_sha256") != fp
@@ -72,102 +146,241 @@ def _supervise(child_argv, ckpt_path, config_path) -> int:
     if stale:
         print(f"[supervise] discarding stale checkpoint {ckpt_path} "
               f"(different or unknown config)", file=sys.stderr, flush=True)
-        for p in (ckpt_path, sidecar, meta_path):
+        lineage.remove_all()
+        for p in (sidecar, meta_path):
             if os.path.exists(p):
                 os.remove(p)
-    with open(meta_path, "w") as f:
-        json.dump({"config_sha256": fp}, f)
+    write_json_atomic(meta_path, {"config_sha256": fp})
     backoff_base = float(os.environ.get("SHADOW1_SUPERVISE_BACKOFF_S", "1.0"))
     last_progress = -1
-    no_progress = 0  # consecutive crashes without forward progress
+    no_progress = 0       # consecutive crashes without forward progress
+    no_progress_hung = 0  # consecutive watchdog kills without progress
     rc = 1
-    for attempt in range(MAX_RESPAWNS + 1):
-        if os.path.exists(ckpt_path):
-            from shadow1_tpu.ckpt import verify_file
 
-            ok, why = verify_file(ckpt_path)
-            if not ok:
-                # Same policy as a stale snapshot: restart from scratch.
-                # The progress baseline resets with it — the next child
+    # Signal plane, parent side: forward the first SIGTERM/SIGINT to the
+    # child (so signaling only the supervisor still drains the run — group
+    # delivery handles the common case, and the child debounces the
+    # duplicate); a second one kills the child hard and re-raises.
+    proc_box: list = [None]
+    sig_seen: list = []
+
+    def _forward(signum, frame):
+        now = _time.monotonic()
+        child = proc_box[0]
+        # Same escalation window as the child's DrainHandler — parent and
+        # child must agree on what counts as a duplicate delivery.
+        if sig_seen and now - sig_seen[0] >= FORCE_GRACE_S:
+            if child is not None and child.poll() is None:
+                child.kill()
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        if not sig_seen:
+            sig_seen.append(now)
+            print(f"[supervise] {signal.Signals(signum).name} received — "
+                  f"forwarding drain request to the child",
+                  file=sys.stderr, flush=True)
+            if child is not None and child.poll() is None:
+                child.send_signal(signal.SIGTERM)
+
+    prev_handlers = {s: signal.signal(s, _forward)
+                     for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        for attempt in range(MAX_RESPAWNS + 1):
+            if sig_seen:
+                # The drain request landed while no child was alive (e.g.
+                # during a backoff sleep, or the child died un-gracefully
+                # right after the forward): honor it here — never respawn
+                # past a preemption notice.
+                print(f"[supervise] preemption requested — not "
+                      f"respawning; checkpoint kept; rerun the same "
+                      f"command to resume", file=sys.stderr, flush=True)
+                _emit_lineage("preempted", rc=EXIT_PREEMPTED)
+                return EXIT_PREEMPTED
+            res = lineage.resolve()
+            if res is not None and res.path is None:
+                # Candidates existed but EVERY generation is damaged: same
+                # policy as a stale snapshot — restart from scratch. The
+                # progress baseline resets with it; the next child
                 # legitimately re-earns its first windows.
+                why = res.skipped[0]["reason"] if res.skipped else "?"
                 print(f"[supervise] discarding corrupt checkpoint "
-                      f"{ckpt_path} ({why}); restarting from scratch",
+                      f"{ckpt_path} ({why}; no valid generation of "
+                      f"{len(res.skipped)}); restarting from scratch",
                       file=sys.stderr, flush=True)
-                for p in (ckpt_path, sidecar):
+                _emit_lineage("discard_all", reason=why,
+                              generations=len(res.skipped))
+                lineage.remove_all()
+                if os.path.exists(sidecar):
+                    os.remove(sidecar)
+                last_progress = -1
+            elif res is not None and res.skipped:
+                # Corrupt head, valid generation behind it: announce; the
+                # child's own resolve falls back (and prunes the damage).
+                print(f"[supervise] checkpoint head "
+                      f"{res.skipped[0]['file']} is corrupt "
+                      f"({res.skipped[0]['reason']}); resume will fall "
+                      f"back to generation {res.seq}",
+                      file=sys.stderr, flush=True)
+                _emit_lineage("corrupt_head", fallback_seq=res.seq,
+                              skipped=len(res.skipped),
+                              reason=res.skipped[0]["reason"])
+            cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
+                   "--supervised-child"]
+            # stdio inherited: heartbeats flow. Popen (not run) so the
+            # watchdog can poll the progress sidecar while waiting.
+            proc = subprocess.Popen(cmd)
+            proc_box[0] = proc
+            if sig_seen and proc.poll() is None:
+                # A drain request that landed between the top-of-loop check
+                # and this assignment had no child to forward to — deliver
+                # it now rather than letting the child run to completion
+                # past a preemption notice.
+                proc.send_signal(signal.SIGTERM)
+            spawn_wall = _time.time()
+            hung = False
+            hung_stale = 0.0
+            poll_s = (max(0.1, min(1.0, watchdog_s / 5))
+                      if watchdog_s > 0 else 1.0)
+            while True:
+                try:
+                    rc = proc.wait(timeout=poll_s)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if watchdog_s <= 0:
+                    continue
+                try:
+                    beat = os.path.getmtime(sidecar)
+                except OSError:
+                    beat = None
+                beaten = beat is not None and beat > spawn_wall
+                ref = beat if beaten else spawn_wall
+                # Before THIS attempt's first beat the child is importing
+                # and compiling (no sidecar ticks yet) — allow 3× the
+                # deadline for startup; after a beat, the configured S.
+                deadline = watchdog_s if beaten else 3 * watchdog_s
+                stale_s = _time.time() - ref
+                if stale_s > deadline:
+                    print(f"[supervise] child hung: progress sidecar "
+                          f"stale {stale_s:.1f}s > watchdog "
+                          f"{deadline:.1f}s — killing pid {proc.pid}",
+                          file=sys.stderr, flush=True)
+                    proc.kill()
+                    rc = proc.wait()
+                    hung = True
+                    hung_stale = stale_s
+                    break
+            proc_box[0] = None
+            if rc == EXIT_CAPACITY:
+                # Capacity halt (--on-overflow halt →
+                # CapacityExceededError): a deterministic config condition,
+                # not a device fault — a respawn would replay the identical
+                # overflow and burn the budget. The child already printed
+                # the structured advice.
+                print(f"[supervise] child halted on a capacity policy "
+                      f"(rc={rc}, CapacityExceededError) — deterministic "
+                      f"config condition; not respawning. Apply the "
+                      f"engine: cap advice above, or rerun with "
+                      f"--on-overflow retry.", file=sys.stderr, flush=True)
+                return rc
+            if rc == EXIT_PREEMPTED:
+                # Clean-resume classification: the child committed its
+                # in-flight chunk and wrote a final snapshot before
+                # exiting. No backoff, no crash accounting, checkpoint
+                # KEPT — rerunning the same command resumes bit-exactly.
+                print(f"[supervise] child drained after a preemption "
+                      f"signal (rc={rc}) — checkpoint kept; rerun the "
+                      f"same command to resume", file=sys.stderr, flush=True)
+                _emit_lineage("preempted", rc=rc)
+                return EXIT_PREEMPTED
+            if rc == EXIT_OK:
+                # A finished run's snapshot must not silently resume a
+                # later invocation of the same command into a no-op.
+                lineage.remove_all()
+                for p in (sidecar, meta_path):
                     if os.path.exists(p):
                         os.remove(p)
-                last_progress = -1
-        cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
-               "--supervised-child"]
-        rc = subprocess.run(cmd).returncode  # stdio inherited: heartbeats flow
-        if rc == EXIT_CAPACITY:
-            # Capacity halt (--on-overflow halt → CapacityExceededError):
-            # a deterministic config condition, not a device fault — a
-            # respawn would replay the identical overflow and burn the
-            # budget. The child already printed the structured advice.
-            print(f"[supervise] child halted on a capacity policy "
-                  f"(rc={rc}, CapacityExceededError) — deterministic "
-                  f"config condition; not respawning. Apply the engine: "
-                  f"cap advice above, or rerun with --on-overflow retry.",
-                  file=sys.stderr, flush=True)
-            return rc
-        if rc == 0:
-            # A finished run's snapshot must not silently resume a later
-            # invocation of the same command into a no-op.
-            for p in (ckpt_path, sidecar, meta_path):
-                if os.path.exists(p):
-                    os.remove(p)
-            return 0
-        progress = -1
-        if os.path.exists(sidecar):
-            try:
-                with open(sidecar) as f:
-                    progress = json.load(f).get("win_start", -1)
-            except (OSError, ValueError):
-                progress = -1
-        if progress > last_progress:
-            no_progress = 0
-            last_progress = progress
-        else:
-            no_progress += 1
-            if no_progress >= 2:
-                print(
-                    f"[supervise] two consecutive crashes (rc={rc}) with no "
-                    f"forward progress at sim_ns={max(progress, 0)} — the "
-                    f"fault is deterministic at that point, further "
-                    f"respawns would repeat it. Diagnose with "
-                    f"`python -m shadow1_tpu.tools.faultprobe` (device/"
-                    f"kernel faults) or `python -m shadow1_tpu.tools."
-                    f"paritytrace {config_path} tpu cpu` (state "
-                    f"divergence).",
-                    file=sys.stderr, flush=True)
+                return EXIT_OK
+            progress = -1
+            if os.path.exists(sidecar):
+                try:
+                    with open(sidecar) as f:
+                        progress = json.load(f).get("win_start", -1)
+                except (OSError, ValueError):
+                    progress = -1
+            if hung:
+                # Every kill gets its record (including one that triggers
+                # the EXIT_HUNG classification below), with the OBSERVED
+                # staleness, not the configured deadline.
+                _emit_lineage("watchdog_kill", stale_s=round(hung_stale, 1),
+                              sim_ns=max(progress, 0), attempt=attempt)
+            if progress > last_progress:
+                no_progress = 0
+                no_progress_hung = 0
+                last_progress = progress
+            elif hung:
+                no_progress_hung += 1
+                if no_progress_hung >= 2:
+                    print(
+                        f"[supervise] two consecutive watchdog kills with "
+                        f"no forward progress at sim_ns={max(progress, 0)} "
+                        f"— the hang is deterministic at that point "
+                        f"(wedged dispatch, dead tunnel), further respawns "
+                        f"would repeat it. Follow the no-kill probe "
+                        f"playbook: `python -m shadow1_tpu.tools."
+                        f"faultprobe` (device liveness without killing the "
+                        f"session), then `python -m shadow1_tpu.tools."
+                        f"paritytrace {config_path} tpu cpu` once the "
+                        f"device answers.", file=sys.stderr, flush=True)
+                    return EXIT_HUNG
+            else:
+                no_progress += 1
+                if no_progress >= 2:
+                    print(
+                        f"[supervise] two consecutive crashes (rc={rc}) "
+                        f"with no forward progress at "
+                        f"sim_ns={max(progress, 0)} — the fault is "
+                        f"deterministic at that point, further respawns "
+                        f"would repeat it. Diagnose with "
+                        f"`python -m shadow1_tpu.tools.faultprobe` "
+                        f"(device/kernel faults) or `python -m shadow1_tpu."
+                        f"tools.paritytrace {config_path} tpu cpu` (state "
+                        f"divergence).", file=sys.stderr, flush=True)
+                    return rc
+            if attempt == MAX_RESPAWNS:
                 return rc
-        if attempt == MAX_RESPAWNS:
-            return rc
-        # Base delay after a crash that made progress (no_progress == 0),
-        # doubled per consecutive no-progress crash — the classifier above
-        # bounds the exponent, not this formula.
-        delay = backoff_base * (2 ** no_progress)
-        print(f"[supervise] child died rc={rc} at sim_ns={progress}; "
-              f"respawning ({attempt + 1}/{MAX_RESPAWNS}) "
-              f"after {delay:.1f}s backoff",
-              file=sys.stderr, flush=True)
-        if delay > 0:
-            _time.sleep(delay)
+            # Base delay after an attempt that made progress, doubled per
+            # consecutive no-progress failure IN ITS LANE (hangs and
+            # crashes back off independently — a wedged tunnel and a
+            # crashing kernel are different pathologies); the classifiers
+            # above bound the exponent, not this formula.
+            delay = backoff_base * (2 ** (no_progress_hung if hung
+                                          else no_progress))
+            kind = "hung (watchdog kill)" if hung else f"died rc={rc}"
+            print(f"[supervise] child {kind} at sim_ns={progress}; "
+                  f"respawning ({attempt + 1}/{MAX_RESPAWNS}) "
+                  f"after {delay:.1f}s backoff",
+                  file=sys.stderr, flush=True)
+            if delay > 0:
+                _time.sleep(delay)
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
     return rc
 
 
-def _fleet_main(args, params, plan, log, t0, capacity_exit) -> int:
+def _fleet_main(args, params, plan, log, t0, capacity_exit,
+                preempted_exit) -> int:
     """The --fleet execution path: one FleetEngine run over the expanded
     sweep, per-experiment final records + a fleet summary on stdout
     (docs/OBSERVABILITY.md §"Fleet records")."""
-    import os
-
     import jax
     import numpy as np
 
     from shadow1_tpu.fleet.engine import FleetEngine
     from shadow1_tpu.fleet.run import final_records, run_fleet
+    from shadow1_tpu.preempt import DrainHandler, PreemptedExit
     from shadow1_tpu.txn import CapacityExceededError
 
     eng = FleetEngine(plan.exps, params, plan.max_rounds)
@@ -177,9 +390,10 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit) -> int:
     metrics0 = None
     # Same resume precedence as the solo path: a --ckpt snapshot on disk
     # (the newer state a supervised respawn continues from) wins over an
-    # explicit --resume. The snapshot is the WHOLE fleet ([E, ...] leaves).
-    resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
-                   else args.resume)
+    # explicit --resume, resolved through the lineage to the newest VALID
+    # generation. The snapshot is the WHOLE fleet ([E, ...] leaves).
+    resolved, ckpt_lineage, resume_path = _resolve_ckpt_lineage(
+        args, log, what="fleet checkpoint")
     if resume_path:
         from shadow1_tpu.ckpt import CorruptCheckpointError, load_state
 
@@ -190,21 +404,26 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit) -> int:
             # crash-loop the respawn budget on a snapshot corrupted after
             # the parent's pre-spawn verification — fall back to a fresh
             # start. An explicit --resume keeps failing loudly.
-            if resume_path != args.ckpt:
+            if resolved is None:
                 raise
             log.warning("discarding corrupt fleet checkpoint",
                         path=resume_path, reason=str(e))
-            st, resume_path = None, None
+            st, resume_path, resolved = None, None, None
         else:
             metrics0 = eng.metrics_per_exp(st)
             done = int(np.asarray(st.win_start).max()) // eng.window
+            if resolved is not None:
+                _emit_resume_record(args.ckpt, resolved,
+                                    int(np.asarray(st.win_start).max()),
+                                    ckpt_lineage)
             if args.windows is None:
                 args.windows = max(eng.n_windows - done, 0)
-            elif resume_path == args.ckpt:
+            elif resolved is not None:
                 # Supervised respawn: --windows is the TOTAL for the whole
                 # supervised run, not N more on top of the snapshot.
                 args.windows = max(args.windows - done, 0)
     ring_w = params.metrics_ring
+    drain = DrainHandler().install()
     try:
         st, _hb = run_fleet(
             eng, st, n_windows=args.windows,
@@ -215,10 +434,14 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit) -> int:
             emit_ring=bool(ring_w),
             selfcheck=bool(params.selfcheck),
             labels=plan.labels,
+            ckpt_keep=args.ckpt_keep,
+            drain=drain,
         )
         jax.block_until_ready(st)
     except CapacityExceededError as e:
         return capacity_exit(e)
+    except PreemptedExit as e:
+        return preempted_exit(e, resumed=bool(resume_path))
     if args.save_state:
         from shadow1_tpu.ckpt import save_state
 
@@ -261,6 +484,24 @@ def main(argv=None) -> int:
                     metavar="S", help="throttle --ckpt snapshots to ~S "
                                       "seconds of wall (saves cost host "
                                       "transfer + npz write)")
+    ap.add_argument("--ckpt-keep", type=int, default=3, metavar="K",
+                    help="checkpoint lineage depth: keep the newest K "
+                         "snapshot generations (the newest at the --ckpt "
+                         "path, older rotated to PATH.gNNNNNN with a "
+                         "PATH.lineage manifest); resume uses the newest "
+                         "generation that passes its integrity digest, so "
+                         "a torn/bit-flipped head costs one generation of "
+                         "progress instead of the whole run")
+    ap.add_argument("--watchdog-s", type=float, default=None, metavar="S",
+                    help="supervisor watchdog: kill a child whose "
+                         ".progress sidecar has not been refreshed for S "
+                         "seconds of wall and classify the attempt as "
+                         "'hung' (distinct backoff lane from crashes; two "
+                         "consecutive no-progress hangs abort with the "
+                         "dedicated exit code). Default: env "
+                         "SHADOW1_WATCHDOG_S, else off. Size it above one "
+                         "chunk's wall; startup (imports + compile, before "
+                         "an attempt's first beat) gets 3x the deadline")
     ap.add_argument("--supervised-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--resume", default=None, metavar="PATH",
@@ -401,7 +642,7 @@ def main(argv=None) -> int:
             print(f"FleetConfigError: {e}", file=sys.stderr, flush=True)
             print(json.dumps({"error": "fleet_config", "kind": e.kind,
                               "knob": e.knob, "message": str(e)}))
-            return 2
+            return EXIT_CONFIG
         if engine_kind != "tpu":
             return _fleet_config_exit(FleetConfigError(
                 f"--fleet batches the single-device tpu engine; "
@@ -442,11 +683,17 @@ def main(argv=None) -> int:
         # respawned child's remaining-window arithmetic ambiguous — refuse.
         ap.error("--ckpt with both --resume and --windows is ambiguous "
                  "(total or N-more?); drop one of them")
+    if args.ckpt_keep < 1:
+        ap.error("--ckpt-keep must be >= 1")
     if args.ckpt and not args.supervised_child:
         # Parent side of fault tolerance: never init the accelerator here —
         # all device work happens in supervised children.
+        import os as _os
+
+        watchdog_s = (args.watchdog_s if args.watchdog_s is not None
+                      else float(_os.environ.get("SHADOW1_WATCHDOG_S", "0")))
         return _supervise(argv if argv is not None else sys.argv[1:],
-                          args.ckpt, args.config)
+                          args.ckpt, args.config, watchdog_s=watchdog_s)
     # Survive a dead/hanging accelerator backend. The CPU oracle needs jax
     # too (it mirrors the RNG streams), but never an accelerator — force
     # CPU directly and skip the probe cost.
@@ -485,12 +732,35 @@ def main(argv=None) -> int:
         }))
         return EXIT_CAPACITY
 
+    def _preempted_exit(e, resumed=False) -> int:
+        """Graceful-drain exit: the in-flight chunk was committed (and the
+        final snapshot written when the run carries --ckpt) before this
+        point — print the parseable stdout record and exit the dedicated
+        code the supervisor classifies as clean-resume (no backoff, no
+        crash accounting; the preemption contract, docs/SEMANTICS.md)."""
+        e.ckpt = args.ckpt  # the chunk runner below this layer doesn't know
+        print(f"[preempt] drain complete after {e.signame}: "
+              f"{e.done_windows} window(s) committed, "
+              f"sim_ns={e.win_start}"
+              + (f", snapshot {args.ckpt}" if args.ckpt
+                 else ", no checkpoint path"),
+              file=sys.stderr, flush=True)
+        print(json.dumps({
+            "preempted": True,
+            "signal": e.signame,
+            "windows_done": e.done_windows,
+            "win_start": e.win_start,
+            "ckpt": args.ckpt,
+            "resumed": bool(resumed),
+        }))
+        return EXIT_PREEMPTED
+
     if args.fleet:
         from shadow1_tpu.fleet.expand import FleetConfigError
 
         try:
             return _fleet_main(args, params, fleet_plan, log, t0,
-                               _capacity_exit)
+                               _capacity_exit, _preempted_exit)
         except FleetConfigError as e:
             # Late rejections (FleetEngine construction) use the same
             # structured exit as the early validation block above.
@@ -535,11 +805,13 @@ def main(argv=None) -> int:
         eng = Eng(exp, params)
         st = None
         # A --ckpt snapshot on disk wins over --resume: it is the newer
-        # state a supervised respawn must continue from.
+        # state a supervised respawn must continue from. The lineage
+        # resolve walks head → older generations and lands on the newest
+        # one that passes its integrity digest (discarding corrupt newer
+        # ones so they can never rotate back into the set).
         import os
 
-        resume_path = (args.ckpt if args.ckpt and os.path.exists(args.ckpt)
-                       else args.resume)
+        resolved, ckpt_lineage, resume_path = _resolve_ckpt_lineage(args, log)
         if resume_path:
             from shadow1_tpu.ckpt import (
                 CorruptCheckpointError,
@@ -574,20 +846,24 @@ def main(argv=None) -> int:
                 # supervisor pre-verifies too; this covers corruption in
                 # between, at no extra hashing on the healthy path). An
                 # explicit --resume keeps failing loudly instead.
-                if resume_path != args.ckpt:
+                if resolved is None:
                     raise
                 log.warning("discarding corrupt checkpoint",
                             path=resume_path, reason=str(e))
                 st, params, eng = None, params0, eng0
+                resume_path, resolved = None, None
             else:
                 metrics0 = Eng.metrics_dict(st)
                 done = int(st.win_start) // exp.window
+                if resolved is not None:
+                    _emit_resume_record(args.ckpt, resolved,
+                                        int(st.win_start), ckpt_lineage)
                 if args.windows is None:
                     # Complete the configured run: only the windows
                     # remaining after the checkpoint, not n_windows again
                     # on top of it.
                     args.windows = max(eng.n_windows - done, 0)
-                elif resume_path == args.ckpt:
+                elif resolved is not None:
                     # Supervised respawn: --windows is the TOTAL for the
                     # whole supervised run, not N more on top of the
                     # snapshot.
@@ -616,6 +892,8 @@ def main(argv=None) -> int:
             guard = OverflowGuard(eng, make_engine=lambda p: Eng(exp, p),
                                   mode=params.on_overflow,
                                   controller=controller, log=log.info)
+        from shadow1_tpu.preempt import DrainHandler, PreemptedExit
+
         try:
             with prof:
                 # phases covers --profile too: its phases.trace.json must
@@ -629,6 +907,11 @@ def main(argv=None) -> int:
                         or guard is not None or params.selfcheck):
                     from shadow1_tpu.obs import run_with_heartbeat
 
+                    # Signal plane: SIGTERM/SIGINT request a graceful
+                    # drain, honored at the next chunk boundary (only the
+                    # chunked path has boundaries to drain at — a plain
+                    # eng.run keeps the default die-on-signal behavior).
+                    drain = DrainHandler().install()
                     st, _hb = run_with_heartbeat(
                         eng, st, n_windows=args.windows,
                         # Ring-only runs chunk at the ring depth so the
@@ -646,12 +929,16 @@ def main(argv=None) -> int:
                         controller=controller,
                         guard=guard,
                         selfcheck=bool(params.selfcheck),
+                        ckpt_keep=args.ckpt_keep,
+                        drain=drain,
                     )
                 else:
                     st = eng.run(st, n_windows=args.windows)
                 jax.block_until_ready(st)
         except CapacityExceededError as e:
             return _capacity_exit(e)
+        except PreemptedExit as e:
+            return _preempted_exit(e, resumed=bool(resume_path))
         if phases is not None:
             if args.trace:
                 phases.write(args.trace)

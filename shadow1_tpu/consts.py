@@ -13,6 +13,38 @@ from __future__ import annotations
 import dataclasses
 
 # --------------------------------------------------------------------------
+# CLI exit-code taxonomy (docs/SEMANTICS.md "Preemption contract", README).
+# One table, defined in this jax-free module so the supervisor, the child,
+# report tools and the tests all read the SAME codes — never magic ints.
+# Any other nonzero exit is an unclassified crash (Python tracebacks exit 1;
+# a signal death surfaces as 128+signum / negative returncode).
+# --------------------------------------------------------------------------
+EXIT_OK = 0          # run completed
+EXIT_CONFIG = 2      # rejected before running: bad flags/config (argparse's
+                     # own error code; structured FleetConfigError exits)
+EXIT_CAPACITY = 4    # --on-overflow halt raised CapacityExceededError —
+                     # deterministic config condition, supervisor never
+                     # respawns (the child printed paste-ready cap advice)
+EXIT_PREEMPTED = 5   # SIGTERM/SIGINT drain: the in-flight chunk was
+                     # committed, a final snapshot written, and a parseable
+                     # {"preempted": ...} record printed — the supervisor
+                     # classifies this as clean-resume (no backoff, no crash
+                     # accounting; rerun the same command to continue)
+EXIT_HUNG = 6        # supervisor abort: the child's progress sidecar went
+                     # stale past --watchdog-s twice consecutively with no
+                     # forward progress — a deterministic wedge, not a
+                     # transient device fault (see the no-kill probe
+                     # playbook: tools/faultprobe)
+
+EXIT_CODES: dict[int, str] = {
+    EXIT_OK: "ok",
+    EXIT_CONFIG: "config rejected (flags/schema/fleet contract)",
+    EXIT_CAPACITY: "capacity halt (CapacityExceededError, advice printed)",
+    EXIT_PREEMPTED: "preempted (graceful drain; resume to continue)",
+    EXIT_HUNG: "hung (watchdog killed a stale child twice, no progress)",
+}
+
+# --------------------------------------------------------------------------
 # Simulation time: int64 nanoseconds (reference SimulationTime is 1ns ticks).
 # --------------------------------------------------------------------------
 NS = 1

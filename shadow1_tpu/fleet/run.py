@@ -140,16 +140,21 @@ def _check_halt(engine, plan_labels, per_exp, prev_per_exp, done, step):
 def run_fleet(engine, st=None, n_windows=None, every_windows=None,
               stream=None, ckpt_path=None, ckpt_every_s=120.0,
               emit_heartbeat=True, emit_ring=True, selfcheck=False,
-              labels=None):
+              labels=None, ckpt_keep=3, drain=None):
     """Run the fleet in chunks. Returns (final_state, FleetHeartbeat).
 
     Mirrors ``obs.run_with_heartbeat``: compile excluded from the first
-    chunk's rate, checkpoints throttled to ``ckpt_every_s`` with the
-    ``.progress`` sidecar the supervisor reads, per-experiment halt /
-    selfcheck boundary checks."""
+    chunk's rate, checkpoints rotated through a ``ckpt_keep``-deep
+    generation set (lineage.Lineage) and throttled to ``ckpt_every_s``,
+    the ``.progress`` sidecar refreshed atomically at EVERY chunk boundary
+    (the watchdog's liveness signal), per-experiment halt / selfcheck
+    boundary checks, and the same signal plane: a pending drain request
+    (``drain``) forces the snapshot and raises preempt.PreemptedExit."""
     import jax
 
     from shadow1_tpu import ckpt as _ckpt
+    from shadow1_tpu.lineage import Lineage, write_json_atomic
+    from shadow1_tpu.preempt import run_injection_hooks
 
     total = n_windows if n_windows is not None else engine.n_windows
     if every_windows is None:
@@ -161,8 +166,10 @@ def run_fleet(engine, st=None, n_windows=None, every_windows=None,
                         emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
     halt = engine.params.on_overflow == "halt"
     prev_per_exp = engine.metrics_per_exp(st)
+    lineage = Lineage(ckpt_path, keep=ckpt_keep) if ckpt_path else None
     last_save = time.perf_counter()
     last_done = [0]
+    last_seq = [None]
 
     def on_chunk(s, done):
         nonlocal prev_per_exp
@@ -182,29 +189,31 @@ def run_fleet(engine, st=None, n_windows=None, every_windows=None,
         prev_per_exp = per_exp
         hb(s, done, per_exp=per_exp)
         sim_ns = int(np.asarray(s.win_start).max())
-        # Fault-injection hooks, same contract as obs.run_with_heartbeat:
-        # die like a wedged device process at an exact sim time (pre- or
-        # post-save flavor) so the supervisor path is testable fleet-shaped
-        # too. Inert without the env vars.
-        crash_pre = os.environ.get("SHADOW1_OBS_CRASH_PRE_SAVE_AT_NS")
-        if crash_pre is not None and sim_ns == int(crash_pre):
-            os._exit(41)
+        # Fault/preemption/hang injection (preempt.run_injection_hooks) —
+        # the same chunk-boundary contract as obs.run_with_heartbeat, so
+        # the supervisor, drain and watchdog paths are all testable
+        # fleet-shaped too. Inert without the env vars.
+        run_injection_hooks(sim_ns)
         nonlocal last_save
         now = time.perf_counter()
-        if ckpt_path and (done >= total or now - last_save > ckpt_every_s):
-            _ckpt.save_state(s, ckpt_path)
-            tmp = ckpt_path + ".progress.tmp"
-            with open(tmp, "w") as f:
-                json.dump({"done_windows": done, "total": total,
-                           "win_start": sim_ns}, f)
-            os.replace(tmp, ckpt_path + ".progress")
+        draining = drain is not None and drain.requested
+        saved = False
+        if lineage is not None and (done >= total or draining
+                                    or now - last_save > ckpt_every_s):
+            last_seq[0] = lineage.save(
+                s, {"win_start": sim_ns, "done_windows": done})
             last_save = now
-            crash_at = os.environ.get("SHADOW1_OBS_CRASH_AT_NS")
-            if crash_at is not None and sim_ns == int(crash_at):
-                os._exit(41)
+            saved = True
+        if ckpt_path:
+            write_json_atomic(ckpt_path + ".progress",
+                              {"done_windows": done, "total": total,
+                               "win_start": sim_ns, "seq": last_seq[0]})
+        crash_at = os.environ.get("SHADOW1_OBS_CRASH_AT_NS")
+        if saved and crash_at is not None and sim_ns == int(crash_at):
+            os._exit(41)
 
     st = _ckpt.run_chunked(engine, st, n_windows=total, chunk=every_windows,
-                           on_chunk=on_chunk)
+                           on_chunk=on_chunk, drain=drain)
     return st, hb
 
 

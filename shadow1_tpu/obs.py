@@ -180,7 +180,8 @@ class Heartbeat:
 def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
                        stream=None, ckpt_path=None, ckpt_every_s=120.0,
                        profiler=None, emit_heartbeat=True, emit_ring=True,
-                       controller=None, guard=None, selfcheck=False):
+                       controller=None, guard=None, selfcheck=False,
+                       ckpt_keep=3, drain=None):
     """Run the engine emitting a heartbeat every ``every_windows`` windows.
 
     With ``ckpt_path``, engine state is snapshotted there at heartbeat
@@ -190,6 +191,17 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     loses at most the windows since the last save, and a supervisor can
     respawn a fresh process that resumes from the snapshot (cli.py --ckpt).
     Determinism makes the resumed run bit-identical to an uninterrupted one.
+    Snapshots rotate through a ``ckpt_keep``-deep generation set
+    (lineage.Lineage, CLI --ckpt-keep) so a corrupt newest snapshot costs
+    one generation of progress, not the run; the ``.progress`` sidecar is
+    refreshed at EVERY chunk boundary (write-then-rename atomic) — it is
+    the liveness signal the supervisor's watchdog reads, so it must tick
+    even between throttled snapshot saves.
+
+    ``drain`` (preempt.DrainHandler — the signal plane): a pending
+    SIGTERM/SIGINT drain request forces the snapshot at the next chunk
+    boundary regardless of the wall throttle, then the chunk runner raises
+    preempt.PreemptedExit (docs/SEMANTICS.md "Preemption contract").
 
     With ``profiler`` (telemetry.PhaseProfiler), the compile warmup, every
     run-chunk, every chunk-boundary drain and every checkpoint save are
@@ -213,7 +225,6 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     """
     import jax
 
-    from shadow1_tpu import ckpt as _ckpt
     from shadow1_tpu.telemetry import PH_INIT
 
     total = n_windows if n_windows is not None else engine.n_windows
@@ -243,46 +254,51 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     if ckpt_path is None:
         st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
                          on_chunk=hb, profiler=profiler, retune=retune,
-                         guard=guard, selfcheck=selfcheck)
+                         guard=guard, selfcheck=selfcheck, drain=drain)
         return st, hb
 
+    from shadow1_tpu.lineage import Lineage, write_json_atomic
+    from shadow1_tpu.preempt import run_injection_hooks
+
+    lineage = Lineage(ckpt_path, keep=ckpt_keep)
     last_save = time.perf_counter()
+    last_seq = [None]
 
     def on_chunk(s, done):
         nonlocal last_save
         hb(s, done)
-        # Fault injection, pre-save flavor: die BEFORE the checkpoint is
-        # written — the supervisor then sees a crash with zero recorded
-        # progress, which is what its failure classifier must recognize
-        # after two identical attempts (cli._supervise). Inert without the
-        # env var; the post-save hook below models the wedge-after-save.
-        crash_pre = os.environ.get("SHADOW1_OBS_CRASH_PRE_SAVE_AT_NS")
-        if crash_pre is not None and int(s.win_start) == int(crash_pre):
-            os._exit(41)
+        sim_ns = int(s.win_start)
+        # Fault/preemption/hang injection (tests, ci.sh, chaosprobe) —
+        # the shared chunk-boundary contract; inert without the env vars.
+        run_injection_hooks(sim_ns)
         now = time.perf_counter()
-        if done >= total or now - last_save > ckpt_every_s:
+        draining = drain is not None and drain.requested
+        saved = False
+        if done >= total or now - last_save > ckpt_every_s or draining:
             with maybe_span(profiler, PH_CHECKPOINT):
-                _ckpt.save_state(s, ckpt_path)
-                # win_start is the absolute sim clock — monotonic across
-                # respawned processes, unlike the invocation-relative
-                # ``done``. Atomic like save_state: a wedge mid-write must
-                # not leave a truncated sidecar that makes the supervisor
-                # abandon a perfectly resumable snapshot.
-                tmp = ckpt_path + ".progress.tmp"
-                with open(tmp, "w") as f:
-                    json.dump({"done_windows": done, "total": total,
-                               "win_start": int(s.win_start)}, f)
-                os.replace(tmp, ckpt_path + ".progress")
+                last_seq[0] = lineage.save(
+                    s, {"win_start": sim_ns, "done_windows": done})
             last_save = now
-            # Fault injection (SURVEY §5 failure-detection analogue): die
-            # like a wedged device process at an exact sim time, once — a
-            # respawned resume starts past it. Exercised by the supervisor
-            # test; inert without the env var.
-            crash_at = os.environ.get("SHADOW1_OBS_CRASH_AT_NS")
-            if crash_at is not None and int(s.win_start) == int(crash_at):
-                os._exit(41)
+            saved = True
+        # The progress sidecar is written at EVERY chunk boundary — it is
+        # the watchdog's liveness signal, so it must tick even between
+        # throttled saves. win_start is the absolute sim clock — monotonic
+        # across respawned processes, unlike the invocation-relative
+        # ``done``. Atomic like save_state: a wedge mid-write must not
+        # leave a truncated sidecar that makes the supervisor abandon a
+        # perfectly resumable snapshot.
+        write_json_atomic(ckpt_path + ".progress",
+                          {"done_windows": done, "total": total,
+                           "win_start": sim_ns, "seq": last_seq[0]})
+        # Fault injection (SURVEY §5 failure-detection analogue): die
+        # like a wedged device process at an exact sim time, once — a
+        # respawned resume starts past it. Exercised by the supervisor
+        # test; inert without the env var.
+        crash_at = os.environ.get("SHADOW1_OBS_CRASH_AT_NS")
+        if saved and crash_at is not None and sim_ns == int(crash_at):
+            os._exit(41)
 
     st = run_chunked(engine, st, n_windows=total, chunk=every_windows,
                      on_chunk=on_chunk, profiler=profiler, retune=retune,
-                     guard=guard, selfcheck=selfcheck)
+                     guard=guard, selfcheck=selfcheck, drain=drain)
     return st, hb

@@ -96,8 +96,15 @@ REC_RING_GAP = "ring_gap"
 REC_DIGEST = "digest"
 REC_FLEET_EXP = "fleet_exp"
 REC_FLEET_SUMMARY = "fleet_summary"
+# Preemption plane (PR 7): ``resume`` = one record per lineage resume (which
+# generation, corrupt newer ones skipped); ``lineage`` = supervisor events
+# (watchdog_kill / preempted / corrupt_head / discard_all) — both on stderr,
+# summarized by tools/heartbeat_report.py's lineage section.
+REC_RESUME = "resume"
+REC_LINEAGE = "lineage"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
-                REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY)
+                REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
+                REC_RESUME, REC_LINEAGE)
 
 # The drop/overflow counter group: every way a modeled event or packet can
 # be discarded, with the human-readable reason. Heartbeat records and the

@@ -1,0 +1,422 @@
+"""Kill-anywhere chaos harness — randomized preemption/crash trials.
+
+    python -m shadow1_tpu.tools.chaosprobe config.yaml [--windows N]
+        [--chunk C] [--trials T] [--seed S] [--fleet] [--no-oracle]
+        [--keep K] [--json-only]
+
+The preemption contract (docs/SEMANTICS.md) claims a supervised run is
+survivable at ANY real-time instant: SIGKILL or SIGTERM, mid-chunk,
+mid-checkpoint-write, with a corrupted snapshot head — the completed run's
+final state and per-window digest stream must be BIT-IDENTICAL to a run
+nothing ever touched. This probe proves it empirically:
+
+1. one STRAIGHT run records the reference digest stream (the flight
+   recorder's per-window ring rows) and final state (.npz, every leaf);
+2. ``--trials`` supervised runs are attacked and relaunched to completion:
+
+   * ``sigkill_group`` / ``sigterm_group`` / ``sigkill_child`` /
+     ``sigterm_child`` — the signal lands at a random real-time offset,
+     on the whole process tree or just the engine child (the supervisor
+     then drains/respawns on its own);
+   * ``drain`` — a deterministic mid-run SIGTERM (the preemption-notice
+     shape): the run must exit EXIT_PREEMPTED after committing, and the
+     relaunch must resume, not restart;
+   * ``torn_head`` — the lineage injection hook tears the newest snapshot
+     mid-write (half-truncated head) and kills the process: resume must
+     fall back one generation, not restart;
+   * ``corrupt_head`` — the tree is SIGKILLed once checkpoints exist,
+     then the newest generation is bit-corrupted on disk before the
+     relaunch: lineage fallback again;
+   * ``mid_write`` — the hook dies exactly between the head rotation and
+     the new-head install (no head on disk at all);
+
+3. every trial's final state is compared leaf-by-leaf against the straight
+   run's, and every per-window digest row ever emitted (across kills,
+   respawns and resumes, deduplicated by window) must bit-match the
+   straight stream. ``--fleet`` runs the whole matrix fleet-shaped
+   (per-experiment streams); the cpu oracle cross-check (``--no-oracle``
+   to skip, solo only) additionally pins the straight stream to the
+   eager reference.
+
+Exit codes follow tools/paritytrace.py: 0 = all trials bit-identical,
+3 = divergence (the last stdout line is a JSON verdict either way; on a
+mismatch it prints the paritytrace invocation that localizes it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+EXIT_DIVERGED = 3
+MAX_LAUNCHES = 8
+
+RANDOM_KINDS = ("sigkill_group", "sigterm_group",
+                "sigkill_child", "sigterm_child")
+SPECIAL_KINDS = ("drain", "torn_head", "corrupt_head", "mid_write")
+
+
+def _child_pids(ppid: int) -> list[int]:
+    """Direct children of ``ppid`` via /proc (no ps dependency)."""
+    kids = []
+    for name in os.listdir("/proc"):
+        if not name.isdigit():
+            continue
+        try:
+            with open(f"/proc/{name}/stat") as f:
+                fields = f.read().rsplit(")", 1)[1].split()
+            if int(fields[1]) == ppid:
+                kids.append(int(name))
+        except (OSError, IndexError, ValueError):
+            continue
+    return kids
+
+
+def _collect_stream(stderr_paths, fleet: bool):
+    """(exp, window) → digest tuple from every launch's ring records.
+
+    A window re-run after a resume re-emits its row; the rows must agree
+    (determinism) — a conflict is itself a divergence."""
+    from shadow1_tpu.core.digest import DIGEST_FIELDS
+
+    stream: dict = {}
+    conflict = None
+    resumes: list[dict] = []
+    lineage_events: list[dict] = []
+    for path in stderr_paths:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            t = rec.get("type")
+            if t == "resume":
+                resumes.append(rec)
+            elif t == "lineage":
+                lineage_events.append(rec)
+            elif t == "ring" and DIGEST_FIELDS[0] in rec:
+                key = (rec.get("exp") if fleet else None, rec["window"])
+                val = tuple(rec[f] for f in DIGEST_FIELDS)
+                if key in stream and stream[key] != val and conflict is None:
+                    conflict = {"window": key[1], "exp": key[0],
+                                "reason": "re-emitted row differs"}
+                stream[key] = val
+    return stream, conflict, resumes, lineage_events
+
+
+def _npz_equal(a_path: str, b_path: str):
+    import numpy as np
+
+    with np.load(a_path) as a, np.load(b_path) as b:
+        if set(a.files) != set(b.files):
+            return f"member sets differ ({len(a.files)} vs {len(b.files)})"
+        for k in a.files:
+            if not np.array_equal(a[k], b[k]):
+                return f"leaf {k} differs"
+    return None
+
+
+def _corrupt_file(path: str) -> None:
+    """Truncate to half — the torn-write shape every filesystem can
+    produce; guaranteed to fail the zip/integrity checks."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.chaosprobe")
+    ap.add_argument("config", help="YAML experiment file")
+    ap.add_argument("--windows", type=int, default=40)
+    ap.add_argument("--chunk", type=int, default=10,
+                    help="heartbeat/checkpoint chunk (windows)")
+    ap.add_argument("--trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="RNG seed for kill kinds/offsets")
+    ap.add_argument("--keep", type=int, default=3,
+                    help="--ckpt-keep lineage depth for the trials")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the matrix fleet-shaped (config needs a "
+                         "sweep: section)")
+    ap.add_argument("--no-oracle", action="store_true",
+                    help="skip the cpu-oracle digest cross-check of the "
+                         "straight run (solo only; fleet skips it anyway "
+                         "— tools/fleetprobe.py covers fleet↔oracle)")
+    ap.add_argument("--timeout-s", type=float, default=600.0,
+                    help="per-launch wall timeout")
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    work = tempfile.mkdtemp(prefix="chaosprobe_")
+    say = (lambda *a: None) if args.json_only else (
+        lambda *a: print(*a, file=sys.stderr, flush=True))
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.consts import EXIT_PREEMPTED
+
+    exp, _, _ = load_experiment(args.config)
+    window_ns = exp.window
+    env0 = {**os.environ, "SHADOW1_SUPERVISE_BACKOFF_S": "0"}
+
+    base = [sys.executable, "-m", "shadow1_tpu", args.config,
+            "--windows", str(args.windows), "--heartbeat", str(args.chunk),
+            "--state-digest", "on"]
+    if args.fleet:
+        base.append("--fleet")
+
+    # ---- straight reference run -----------------------------------------
+    ref_npz = os.path.join(work, "ref.npz")
+    ref_err = os.path.join(work, "ref.stderr")
+    t0 = time.perf_counter()
+    with open(ref_err, "w") as ef:
+        r = subprocess.run([*base, "--save-state", ref_npz], env=env0,
+                           stdout=subprocess.DEVNULL, stderr=ef,
+                           timeout=args.timeout_s)
+    straight_wall = time.perf_counter() - t0
+    if r.returncode != 0:
+        print(json.dumps({"ok": False, "error": "straight run failed",
+                          "rc": r.returncode, "stderr": ref_err}))
+        return 1
+    ref_stream, conflict, _, _ = _collect_stream([ref_err], args.fleet)
+    assert conflict is None
+    if not ref_stream:
+        print(json.dumps({"ok": False,
+                          "error": "straight run emitted no digest rows"}))
+        return 1
+    say(f"[chaosprobe] straight run: {len(ref_stream)} digest rows, "
+        f"{straight_wall:.1f}s wall")
+
+    # ---- cpu-oracle cross-check of the straight stream ------------------
+    oracle_checked = False
+    if not args.no_oracle and not args.fleet:
+        orc_err = os.path.join(work, "oracle.stderr")
+        with open(orc_err, "w") as ef:
+            r = subprocess.run(
+                [sys.executable, "-m", "shadow1_tpu", args.config,
+                 "--engine", "cpu", "--windows", str(args.windows),
+                 "--state-digest", "on"],
+                env=env0, stdout=subprocess.DEVNULL, stderr=ef,
+                timeout=args.timeout_s)
+        if r.returncode != 0:
+            print(json.dumps({"ok": False, "error": "oracle run failed",
+                              "rc": r.returncode}))
+            return 1
+        from shadow1_tpu.core.digest import DIGEST_FIELDS
+
+        orc = {}
+        with open(orc_err) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    rec = json.loads(line)
+                    if rec.get("type") == "digest":
+                        orc[(None, rec["window"])] = tuple(
+                            rec[f] for f in DIGEST_FIELDS)
+        if orc != ref_stream:
+            bad = sorted(w for w in ref_stream
+                         if orc.get(w) != ref_stream[w])[:1]
+            print(json.dumps({
+                "ok": False, "error": "straight run diverges from the cpu "
+                "oracle (not a chaos failure — bisect it first)",
+                "first_window": bad,
+                "paritytrace": f"python -m shadow1_tpu.tools.paritytrace "
+                               f"{args.config} tpu cpu --windows "
+                               f"{args.windows}"}))
+            return EXIT_DIVERGED
+        oracle_checked = True
+        say(f"[chaosprobe] oracle cross-check ok ({len(orc)} windows)")
+
+    # ---- trial schedule --------------------------------------------------
+    kinds = list(SPECIAL_KINDS[:max(0, min(args.trials, len(SPECIAL_KINDS)))])
+    while len(kinds) < args.trials:
+        kinds.append(rng.choice(RANDOM_KINDS))
+    rng.shuffle(kinds)
+
+    verdicts = []
+    total_launches = 0
+    total_preempted = 0
+    total_fallbacks = 0
+
+    for ti, kind in enumerate(kinds):
+        ck = os.path.join(work, f"t{ti}.npz")
+        fin = os.path.join(work, f"t{ti}_fin.npz")
+        errs = []
+        launches = 0
+        preempted = 0
+        killed = 0
+        trial_err = None
+        mid_kill_boundary = (args.windows // 2) * window_ns
+        while launches < MAX_LAUNCHES:
+            env = dict(env0)
+            if kind == "drain" and launches == 0:
+                # Deterministic preemption notice at the mid boundary.
+                env["SHADOW1_OBS_SIGTERM_SELF_AT_NS"] = str(mid_kill_boundary)
+            if kind == "torn_head" and launches == 1:
+                env["SHADOW1_LINEAGE_TORN_HEAD"] = os.path.join(
+                    work, f"t{ti}.torn.flag")
+            if kind == "mid_write" and launches == 1:
+                env["SHADOW1_LINEAGE_CRASH_BETWEEN"] = os.path.join(
+                    work, f"t{ti}.between.flag")
+            err_path = os.path.join(work, f"t{ti}_l{launches}.stderr")
+            errs.append(err_path)
+            with open(err_path, "w") as ef:
+                proc = subprocess.Popen(
+                    [*base, "--ckpt", ck, "--ckpt-every-s", "0",
+                     "--ckpt-keep", str(args.keep), "--save-state", fin],
+                    env=env, stdout=subprocess.DEVNULL, stderr=ef,
+                    start_new_session=True)
+                launches += 1
+                want_kill = (launches == 1 and
+                             (kind in RANDOM_KINDS
+                              or kind in ("corrupt_head", "torn_head",
+                                          "mid_write")))
+                if want_kill and kind in ("corrupt_head", "torn_head",
+                                          "mid_write"):
+                    # Kill only once checkpoints exist: poll the progress
+                    # sidecar, then SIGKILL the whole tree. The relaunch
+                    # then carries the injection env (torn_head/mid_write)
+                    # or finds the head corrupted (corrupt_head).
+                    deadline = time.time() + args.timeout_s
+                    while time.time() < deadline and proc.poll() is None:
+                        if os.path.exists(ck + ".progress"):
+                            break
+                        time.sleep(0.05)
+                    if proc.poll() is None:
+                        killed += 1
+                        os.killpg(proc.pid, signal.SIGKILL)
+                elif want_kill:
+                    sig = (signal.SIGKILL if kind.startswith("sigkill")
+                           else signal.SIGTERM)
+                    offset = rng.uniform(0.05, max(straight_wall, 0.5))
+                    target_end = time.time() + offset
+                    while time.time() < target_end and proc.poll() is None:
+                        time.sleep(0.02)
+                    if proc.poll() is None:
+                        killed += 1
+                        if kind.endswith("_child"):
+                            kids = _child_pids(proc.pid)
+                            if kids:
+                                for pid in kids:
+                                    try:
+                                        os.kill(pid, sig)
+                                    except ProcessLookupError:
+                                        pass
+                            else:  # child not up yet: hit the tree
+                                os.killpg(proc.pid, sig)
+                        else:
+                            os.killpg(proc.pid, sig)
+                try:
+                    rc = proc.wait(timeout=args.timeout_s)
+                except subprocess.TimeoutExpired:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                    trial_err = "launch timeout"
+                    break
+            if rc == 0:
+                break
+            if rc == EXIT_PREEMPTED:
+                preempted += 1
+                continue
+            if rc in (1, 2):
+                trial_err = f"launch exited rc={rc} (not a kill)"
+                break
+            # killed / crashed tree: corrupt the head once for the
+            # corrupt_head kind, then relaunch-to-resume.
+            if kind == "corrupt_head" and launches == 1 \
+                    and os.path.exists(ck):
+                _corrupt_file(ck)
+        else:
+            trial_err = trial_err or f"no clean exit in {MAX_LAUNCHES} launches"
+        total_launches += launches
+        total_preempted += preempted
+        if trial_err is None and not os.path.exists(fin):
+            trial_err = "final state was never written"
+        stream, conflict, resumes, _events = _collect_stream(errs, args.fleet)
+        fallbacks = sum(1 for r in resumes if r.get("fallback_skipped"))
+        # mid_write leaves no corrupt file to skip — the fallback shows as
+        # a resume from a generation older than the torn head's seq.
+        if kind in ("torn_head", "mid_write", "corrupt_head"):
+            fb_text = any("fall back" in line or "discarding corrupt" in line
+                          for p in errs if os.path.exists(p)
+                          for line in open(p))
+            if fallbacks == 0 and fb_text:
+                fallbacks = 1
+        total_fallbacks += fallbacks
+        mismatch = None
+        if trial_err is None:
+            mismatch = conflict
+            if mismatch is None:
+                missing = [k for k in ref_stream if k not in stream]
+                if missing:
+                    mismatch = {"reason": f"{len(missing)} straight "
+                                          f"window(s) never re-emitted",
+                                "first": list(missing[0])}
+            if mismatch is None:
+                for key in sorted(ref_stream):
+                    if stream[key] != ref_stream[key]:
+                        mismatch = {"exp": key[0], "window": key[1],
+                                    "reason": "digest row differs"}
+                        break
+            if mismatch is None:
+                why = _npz_equal(ref_npz, fin)
+                if why:
+                    mismatch = {"reason": f"final state differs: {why}"}
+        v = {"trial": ti, "kind": kind, "launches": launches,
+             "killed": killed, "preempted_exits": preempted,
+             "lineage_fallbacks": fallbacks,
+             "ok": trial_err is None and mismatch is None}
+        if trial_err:
+            v["error"] = trial_err
+        if mismatch:
+            v["mismatch"] = mismatch
+        verdicts.append(v)
+        say(f"[chaosprobe] trial {ti} ({kind}): "
+            f"{'ok' if v['ok'] else 'FAIL'} — {launches} launch(es), "
+            f"{preempted} preempted, {fallbacks} fallback(s)")
+        if not v["ok"]:
+            break
+
+    ok = all(v["ok"] for v in verdicts) and len(verdicts) == args.trials
+    summary = {
+        "ok": ok,
+        "trials": len(verdicts),
+        "windows": args.windows,
+        "fleet": bool(args.fleet),
+        "oracle_checked": oracle_checked,
+        "digest_rows": len(ref_stream),
+        "launches": total_launches,
+        "preempted_exits": total_preempted,
+        "lineage_fallbacks": total_fallbacks,
+        "kinds": {k: sum(1 for v in verdicts if v["kind"] == k)
+                  for k in dict.fromkeys(kinds)},
+        "straight_wall_s": round(straight_wall, 1),
+    }
+    if not ok:
+        bad = next(v for v in verdicts if not v["ok"])
+        summary["first_failure"] = bad
+        summary["paritytrace"] = (
+            f"python -m shadow1_tpu.tools.paritytrace {args.config} "
+            f"tpu tpu+resume --windows {args.windows} "
+            f"--chunk {args.chunk}")
+    print(json.dumps(summary))
+    return 0 if ok else EXIT_DIVERGED
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

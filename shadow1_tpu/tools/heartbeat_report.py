@@ -23,6 +23,8 @@ from shadow1_tpu.telemetry.registry import (
     DROP_SPECS,
     REC_FLEET_EXP,
     REC_HEARTBEAT,
+    REC_LINEAGE,
+    REC_RESUME,
     REC_RING,
     REC_RING_GAP,
     REC_TRACKER,
@@ -163,6 +165,38 @@ def summarize(recs: list[dict], out=None) -> dict:
                 caps = "  ".join(f"{k}: {v}"
                                  for k, v in last["caps"].items())
                 print(f"  final caps: {caps}", file=out)
+    resumes = [r for r in recs if r.get("type") == REC_RESUME]
+    lineage = [r for r in recs if r.get("type") == REC_LINEAGE]
+    if resumes or lineage:
+        # Preemption/lineage plane: how the run survived — resumes taken
+        # (which generation each landed on), corrupt heads skipped,
+        # watchdog kills, drains (docs/OBSERVABILITY.md §"Resume and
+        # lineage records").
+        wk = [r for r in lineage if r.get("event") == "watchdog_kill"]
+        drains = [r for r in lineage if r.get("event") == "preempted"]
+        summary["lineage"] = {
+            "resumes": len(resumes),
+            "fallback_skipped": sum(r.get("fallback_skipped", 0)
+                                    for r in resumes),
+            "watchdog_kills": len(wk),
+            "preempted_drains": len(drains),
+        }
+        if resumes:
+            summary["lineage"]["generations_kept"] = \
+                resumes[-1].get("generations_kept")
+        print("== lineage (preemption/resume) ==", file=out)
+        for k, v in summary["lineage"].items():
+            print(f"  {k}: {v}", file=out)
+        for r in resumes:
+            extra = (f"  ({r['fallback_skipped']} corrupt newer "
+                     f"generation(s) skipped)"
+                     if r.get("fallback_skipped") else "")
+            print(f"  resume: generation {r.get('generation')} at "
+                  f"sim_ns {r.get('win_start')}{extra}", file=out)
+        for r in wk:
+            print(f"  watchdog kill: sidecar stale > {r.get('stale_s')}s "
+                  f"at sim_ns {r.get('sim_ns')} (attempt "
+                  f"{r.get('attempt')})", file=out)
     if rings:
         # Fleet runs tag each ring row with its experiment id (``exp``):
         # group the per-window stats PER EXPERIMENT — mixing lanes would

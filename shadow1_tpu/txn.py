@@ -44,7 +44,9 @@ from __future__ import annotations
 
 # CLI exit code for a CapacityExceededError halt (distinct from generic
 # crashes so cli._supervise can classify it without parsing stderr).
-EXIT_CAPACITY = 4
+# Canonically defined in the consts.py exit-code taxonomy; re-exported here
+# for the existing importers.
+from shadow1_tpu.consts import EXIT_CAPACITY  # noqa: F401
 
 # Overflow counter → the capacity knob whose growth recovers it.
 OVERFLOW_KNOBS: dict[str, str] = {

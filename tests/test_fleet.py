@@ -19,7 +19,7 @@ import pytest
 
 from shadow1_tpu.ckpt import load_state, run_chunked, save_state
 from shadow1_tpu.config.compiled import single_vertex_experiment
-from shadow1_tpu.consts import MS, EngineParams
+from shadow1_tpu.consts import EXIT_CONFIG, MS, EngineParams
 from shadow1_tpu.core.digest import SUBSYSTEMS
 from shadow1_tpu.core.engine import Engine
 from shadow1_tpu.cpu_engine import CpuEngine
@@ -513,13 +513,13 @@ def test_cli_fleet_structured_rejections(tmp_path):
         return out.returncode, out.stdout.strip().splitlines()
 
     rc, lines = run("--engine", "sharded")
-    assert rc == 2
+    assert rc == EXIT_CONFIG
     err = json.loads(lines[-1])
     assert err["error"] == "fleet_config" and err["kind"] == "mode"
     rc, lines = run("--auto-caps")
-    assert rc == 2 and json.loads(lines[-1])["knob"] == "auto_caps"
+    assert rc == EXIT_CONFIG and json.loads(lines[-1])["knob"] == "auto_caps"
     rc, lines = run("--on-overflow", "retry")
-    assert rc == 2 and json.loads(lines[-1])["knob"] == "on_overflow"
+    assert rc == EXIT_CONFIG and json.loads(lines[-1])["knob"] == "on_overflow"
     # No sweep: section -> schema-kind rejection.
     solo = tmp_path / "solo.yaml"
     solo.write_text(cfg.read_text().replace("sweep: {seeds: [7, 8, 9]}\n",
@@ -527,7 +527,7 @@ def test_cli_fleet_structured_rejections(tmp_path):
     out = subprocess.run(
         [sys.executable, "-m", "shadow1_tpu", str(solo), "--fleet"],
         capture_output=True, text=True)
-    assert out.returncode == 2
+    assert out.returncode == EXIT_CONFIG
     assert json.loads(out.stdout.strip().splitlines()[-1])["kind"] == \
         "schema"
 
