@@ -196,6 +196,56 @@ print("chaosprobe:", d["trials"], "kill trials bit-identical;",
       d["preempted_exits"], "drain(s),", d["lineage_fallbacks"],
       "lineage fallback(s)")
 '
+    echo "== memory-plane smoke (pre-flight budget + sub-batch parity) =="
+    # The deliberately oversubscribed config must be rejected BEFORE any
+    # compile with the dedicated memory exit code, a parseable stdout
+    # record and per-plane advice (shadow1_tpu/mem.py; the budget comes
+    # from the env override — the CPU backend reports no device memory).
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" SHADOW1_MEM_BYTES=$((8<<30)) \
+        python -m shadow1_tpu configs/mem_overbudget.yaml \
+        >/tmp/_mem_ob.out 2>/tmp/_mem_ob.err && rc=0 || rc=$?
+    exp_rc=$(python -c 'from shadow1_tpu.consts import EXIT_MEMORY; print(EXIT_MEMORY)')
+    [ "$rc" -eq "$exp_rc" ] || { echo "mem: expected EXIT_MEMORY=$exp_rc, got $rc" >&2; exit 1; }
+    python -c '
+import json
+d = json.loads(open("/tmp/_mem_ob.out").read().strip().splitlines()[-1])
+assert d["error"] == "memory_budget", d
+assert d["estimated"] > d["budget"], d
+assert d["planes"]["evbuf"] > (16 << 30), d
+assert "Remedies" in d["advice"], d
+print("mem: pre-flight rejected", round(d["estimated"]/2**30, 1),
+      "GiB estimate before compile, advice block present")
+'
+    grep -q "MemoryBudgetError" /tmp/_mem_ob.err || { echo "mem: stderr advice missing" >&2; exit 1; }
+    rm -f /tmp/_mem_ob.out /tmp/_mem_ob.err
+    # Sub-batched-fleet == full-fleet bit-exactness (the --on-oom
+    # downshift contract): per-lane digest streams and parity counters
+    # must be identical when the sweep runs as sequential sub-batches.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.memprobe \
+        configs/sweep_phold.yaml --subbatch --sub 3 --windows 16 \
+        --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+sb = d["subbatch"]
+assert sb["experiments"] == 4 and sb["streams_compared"] == 4, sb
+print("memprobe: 4-lane sweep sub-batched (3+1) bit-identical per lane,",
+      sb["windows"], "windows")
+'
+    echo "== bench regression gate (BENCH_GATE.json, ms/round) =="
+    # ROADMAP item 5: nothing used to ENFORCE the perf trajectory. The
+    # gate fails on >5% ms/round regression vs the committed baseline;
+    # intentional trade-offs override once with
+    # SHADOW1_BENCH_GATE_ACCEPT="why" and then re-baseline via --update.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.benchgate \
+        | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["gate"] in ("ok", "accepted", "skipped_backend_mismatch",
+                     "skipped_host_mismatch", "no_baseline"), d
+print("benchgate:", d["gate"], "-", d["ms_per_round"], "ms/round vs",
+      d.get("baseline_ms_per_round"), "baseline")
+'
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '
 import tempfile, os

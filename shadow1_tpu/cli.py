@@ -22,8 +22,10 @@ MAX_RESPAWNS = 8
 # as literals nowhere (docs/SEMANTICS.md "Preemption contract", README).
 from shadow1_tpu.consts import (  # noqa: E402 (jax-free module)
     EXIT_CAPACITY,
+    EXIT_CODES,
     EXIT_CONFIG,
     EXIT_HUNG,
+    EXIT_MEMORY,
     EXIT_OK,
     EXIT_PREEMPTED,
 )
@@ -110,10 +112,19 @@ def _supervise(child_argv, ckpt_path, config_path,
       ``win_start`` mean the fault is deterministic at that sim time — a
       third identical attempt would burn the respawn budget for nothing,
       so the supervisor aborts with a diagnosis instead.
+    * **memory classification** (rc == EXIT_MEMORY, or belt-and-braces: a
+      raw ``RESOURCE_EXHAUSTED`` on the child's stderr even when the
+      structured taxonomy was bypassed — a crash deep in backend init, an
+      allocator abort): device memory exhaustion is a deterministic
+      config-vs-device condition, so the supervisor never respawns into
+      the same wall; it points at the estimator's advice instead. The
+      child's stderr is teed through a scanning thread so heartbeats
+      still flow to the parent's stderr unchanged.
     """
     import os
     import signal
     import subprocess
+    import threading
     import time as _time
 
     from shadow1_tpu.lineage import Lineage, write_json_atomic
@@ -227,9 +238,34 @@ def _supervise(child_argv, ckpt_path, config_path,
                               reason=res.skipped[0]["reason"])
             cmd = [sys.executable, "-m", "shadow1_tpu", *child_argv,
                    "--supervised-child"]
-            # stdio inherited: heartbeats flow. Popen (not run) so the
-            # watchdog can poll the progress sidecar while waiting.
-            proc = subprocess.Popen(cmd)
+            # stdout inherited: final JSON flows. stderr is TEED through a
+            # scanning thread (heartbeats still reach the parent's stderr
+            # line-for-line) so a raw RESOURCE_EXHAUSTED crash — one that
+            # bypassed the structured EXIT_MEMORY taxonomy entirely — is
+            # still classified as deterministic memory exhaustion below
+            # instead of crash-looping through the backoff ladder. Popen
+            # (not run) so the watchdog can poll the progress sidecar
+            # while waiting.
+            proc = subprocess.Popen(cmd, stderr=subprocess.PIPE, text=True,
+                                    errors="replace")
+            # [line_count, line index of the last RESOURCE_EXHAUSTED] — the
+            # classifier below requires the marker NEAR death, so a
+            # non-fatal allocator warning early in a long run (GPU
+            # autotuners log these and continue) cannot misclassify an
+            # unrelated later crash as memory exhaustion.
+            oom_seen = [0, None]
+
+            def _tee(pipe=proc.stderr, seen=oom_seen):
+                for line in iter(pipe.readline, ""):
+                    seen[0] += 1
+                    if "RESOURCE_EXHAUSTED" in line:
+                        seen[1] = seen[0]
+                    sys.stderr.write(line)
+                sys.stderr.flush()
+                pipe.close()
+
+            tee = threading.Thread(target=_tee, daemon=True)
+            tee.start()
             proc_box[0] = proc
             if sig_seen and proc.poll() is None:
                 # A drain request that landed between the top-of-loop check
@@ -272,6 +308,32 @@ def _supervise(child_argv, ckpt_path, config_path,
                     hung_stale = stale_s
                     break
             proc_box[0] = None
+            tee.join(timeout=10.0)  # drain the scan before classifying
+            # Raw-marker classification is deliberately narrow: a plain
+            # crash exit (rc > 0, not a structured taxonomy code, not a
+            # signal death, not a watchdog kill) whose stderr ENDED with
+            # the marker — the dying traceback — within the last 50
+            # lines. Everything else falls through to the PR 4/7
+            # crash/hung classifiers and keeps its recovery path.
+            raw_oom = (rc > 0 and rc not in EXIT_CODES and not hung
+                       and oom_seen[1] is not None
+                       and oom_seen[0] - oom_seen[1] <= 50)
+            if rc == EXIT_MEMORY or raw_oom:
+                # Memory exhaustion (structured pre-flight/runtime exit,
+                # or — belt and braces — a raw RESOURCE_EXHAUSTED crash
+                # the taxonomy never saw): deterministic config-vs-device
+                # condition; a respawn replays the identical allocation
+                # and burns the budget for nothing.
+                how = ("rc=EXIT_MEMORY" if rc == EXIT_MEMORY
+                       else f"rc={rc}, raw RESOURCE_EXHAUSTED on stderr")
+                print(f"[supervise] child exhausted device memory ({how}) "
+                      f"— deterministic config-vs-device condition; not "
+                      f"respawning. Apply the memory advice above, rerun "
+                      f"with --on-oom downshift, or probe the feasible "
+                      f"envelope: python -m shadow1_tpu.tools.memprobe "
+                      f"{config_path} --maxfit",
+                      file=sys.stderr, flush=True)
+                return EXIT_MEMORY
             if rc == EXIT_CAPACITY:
                 # Capacity halt (--on-overflow halt →
                 # CapacityExceededError): a deterministic config condition,
@@ -371,19 +433,31 @@ def _supervise(child_argv, ckpt_path, config_path,
 
 
 def _fleet_main(args, params, plan, log, t0, capacity_exit,
-                preempted_exit) -> int:
+                preempted_exit, memory_exit=None, sub_batch=None) -> int:
     """The --fleet execution path: one FleetEngine run over the expanded
     sweep, per-experiment final records + a fleet summary on stdout
-    (docs/OBSERVABILITY.md §"Fleet records")."""
+    (docs/OBSERVABILITY.md §"Fleet records"). ``sub_batch`` (set by the
+    --on-oom downshift planner) routes to the sequential sub-batched
+    runner instead; ``memory_exit`` maps a runtime RESOURCE_EXHAUSTED to
+    the structured EXIT_MEMORY taxonomy."""
     import jax
     import numpy as np
 
+    from shadow1_tpu import mem
     from shadow1_tpu.fleet.engine import FleetEngine
     from shadow1_tpu.fleet.run import final_records, run_fleet
     from shadow1_tpu.preempt import DrainHandler, PreemptedExit
     from shadow1_tpu.txn import CapacityExceededError
 
-    eng = FleetEngine(plan.exps, params, plan.max_rounds)
+    if sub_batch and sub_batch < len(plan.exps):
+        return _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
+                                 preempted_exit, memory_exit, sub_batch)
+    try:
+        eng = FleetEngine(plan.exps, params, plan.max_rounds)
+    except Exception as e:
+        if memory_exit is not None and mem.is_oom(e):
+            return memory_exit(e, phase="init")
+        raise
     log.info("fleet expanded", experiments=eng.n_exp,
              hosts=eng.exp.n_hosts, window_ns=eng.window)
     st = None
@@ -425,6 +499,10 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
     ring_w = params.metrics_ring
     drain = DrainHandler().install()
     try:
+        import os as _os
+
+        if _os.environ.get("SHADOW1_MEM_INJECT_OOM") == "run":
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected (test hook)")
         st, _hb = run_fleet(
             eng, st, n_windows=args.windows,
             every_windows=args.heartbeat or (ring_w or None),
@@ -442,6 +520,10 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
         return capacity_exit(e)
     except PreemptedExit as e:
         return preempted_exit(e, resumed=bool(resume_path))
+    except Exception as e:
+        if memory_exit is not None and mem.is_oom(e):
+            return memory_exit(e)
+        raise
     if args.save_state:
         from shadow1_tpu.ckpt import save_state
 
@@ -454,6 +536,123 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
     for r in recs:
         print(json.dumps(r))
     print(json.dumps(summary))
+    return 0
+
+
+def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
+                      preempted_exit, memory_exit, sub: int) -> int:
+    """Memory-downshifted fleet: the sweep's E lanes run as SEQUENTIAL
+    sub-batches of ≤ ``sub`` lanes, each its own vmapped FleetEngine run
+    (cli --on-oom downshift; mem.downshift sized ``sub`` so one batch fits
+    the device budget).
+
+    Bit-exactness: lanes are independent — counter-based RNG keyed per
+    (seed, host, ctr), per-lane fault tables, per-lane selects in the
+    batched while_loop — so lane e's digest stream and metrics are
+    IDENTICAL whether it runs beside 2 or 200 other lanes
+    (tools/memprobe.py --subbatch is the per-invocation proof, the fleet
+    contract's fleetprobe idiom). Each batch prints its fleet_exp records
+    with SWEEP-GLOBAL experiment ids (FleetEngine.exp_base) as it
+    finishes; one merged fleet_summary closes the run. --ckpt/--resume
+    were refused by the downshift planner (a sub-batched sweep has no
+    single all-lane snapshot). A drain request between batches stops the
+    sweep there — finished lanes keep their records."""
+    import jax
+
+    from shadow1_tpu import mem
+    from shadow1_tpu.fleet.engine import FleetEngine
+    from shadow1_tpu.fleet.run import final_records, run_fleet
+    from shadow1_tpu.preempt import DrainHandler, PreemptedExit
+    from shadow1_tpu.telemetry.registry import gauge_names
+    from shadow1_tpu.txn import CapacityExceededError
+
+    E = len(plan.exps)
+    n_batches = -(-E // sub)
+    log.info("fleet sub-batched for memory", experiments=E,
+             lanes_per_batch=sub, batches=n_batches)
+    drain = DrainHandler().install()
+    ring_w = params.metrics_ring
+    summaries: list[dict] = []
+    windows_done = args.windows
+    lanes_run = 0
+    for i in range(0, E, sub):
+        exps = plan.exps[i:i + sub]
+        labels = plan.labels[i:i + sub]
+        try:
+            eng = FleetEngine(exps, params, plan.max_rounds[i:i + sub])
+            eng.exp_base = i
+            st, _hb = run_fleet(
+                eng, None, n_windows=args.windows,
+                every_windows=args.heartbeat or (ring_w or None),
+                stream=None if (args.heartbeat or ring_w) else False,
+                emit_heartbeat=bool(args.heartbeat),
+                emit_ring=bool(ring_w),
+                selfcheck=bool(params.selfcheck),
+                labels=labels,
+                drain=drain,
+            )
+            jax.block_until_ready(st)
+        except CapacityExceededError as e:
+            return capacity_exit(e)
+        except PreemptedExit as e:
+            return preempted_exit(e, resumed=False)
+        except Exception as e:
+            if memory_exit is not None and mem.is_oom(e):
+                return memory_exit(e)
+            raise
+        n_windows = (args.windows if args.windows is not None
+                     else eng.n_windows)
+        windows_done = n_windows
+        recs, summary = final_records(eng, st, labels, n_windows,
+                                      time.perf_counter() - t0)
+        for r in recs:
+            print(json.dumps(r))
+        summaries.append(summary)
+        lanes_run += len(exps)
+        if drain.requested and lanes_run < E:
+            # done_windows keeps its documented unit (windows committed
+            # this invocation — preempt.py), matching the full-fleet
+            # drain for the same run; finished lanes already printed
+            # their fleet_exp records above.
+            return preempted_exit(PreemptedExit(
+                st=None, signame=drain.signame,
+                done_windows=n_windows,
+                win_start=int(summary.get("sim_seconds", 0) * 1e9)),
+                resumed=False)
+    # Merged fleet_summary: counters sum, gauges (and the lockstep
+    # windows/rounds) max across batches — the same aggregation rule as
+    # FleetEngine.metrics_dict, applied one level up.
+    maxed = set(gauge_names()) | {"windows", "rounds"}
+    agg: dict[str, int] = {}
+    for s in summaries:
+        for k, v in s["metrics"].items():
+            agg[k] = (max(agg.get(k, 0), int(v)) if k in maxed
+                      else agg.get(k, 0) + int(v))
+    wall = time.perf_counter() - t0
+    s0 = summaries[0]
+    sim_s = s0["sim_seconds"]
+    merged = {
+        "type": "fleet_summary",
+        "engine": "fleet",
+        "experiments": E,
+        "hosts": s0["hosts"],
+        "window_ns": s0["window_ns"],
+        "windows": windows_done,
+        "sim_seconds": sim_s,
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(sim_s / wall, 3) if wall > 0 else None,
+        "events_per_sec": (round(agg.get("events", 0) / wall, 1)
+                           if wall > 0 else None),
+        "events_per_exp": [e for s in summaries
+                           for e in s["events_per_exp"]],
+        "resumed": False,
+        "caps": s0["caps"],
+        "metrics": agg,
+        # The downshift audit: how the sweep was split for memory.
+        "sub_batches": n_batches,
+        "lanes_per_batch": sub,
+    }
+    print(json.dumps(merged))
     return 0
 
 
@@ -550,6 +749,21 @@ def main(argv=None) -> int:
                          "stream bit-matches a straight run at the final "
                          "caps; halt = raise CapacityExceededError with "
                          "paste-ready cap advice (exit code 4)")
+    ap.add_argument("--on-oom", choices=["halt", "downshift"],
+                    default="halt", metavar="halt|downshift",
+                    help="memory-budget policy (shadow1_tpu/mem.py): the "
+                         "pre-flight byte estimator compares the engine "
+                         "state planes + known transient peaks against the "
+                         "device's reported memory (env SHADOW1_MEM_BYTES "
+                         "overrides) BEFORE compiling. halt (default) = "
+                         "reject an oversubscribed config with a "
+                         "structured MemoryBudgetError (per-plane bytes + "
+                         "paste-ready advice, exit code 7); downshift = "
+                         "degrade gracefully in bit-exactness-preserving "
+                         "order: drop the txn rollback copy (retry demotes "
+                         "to halt), shrink the telemetry ring, split a "
+                         "fleet into sequential sub-batches (per-lane "
+                         "digest streams stay bit-identical)")
     ap.add_argument("--selfcheck", action="store_true",
                     help="verify the drop-accounting identity (every sent "
                          "packet reaches exactly one counted fate) at every "
@@ -619,11 +833,12 @@ def main(argv=None) -> int:
                                  or args.profile or args.ckpt
                                  or args.trace or args.metrics_ring
                                  or args.auto_caps
-                                 or args.on_overflow == "retry"):
+                                 or args.on_overflow == "retry"
+                                 or args.on_oom == "downshift"):
         ap.error("--save-state/--resume/--heartbeat/--tracker/--profile/"
                  "--ckpt/--trace/--metrics-ring/--auto-caps/"
-                 "--on-overflow retry require a batched engine "
-                 "(tpu or sharded)")
+                 "--on-overflow retry/--on-oom downshift require a batched "
+                 "engine (tpu or sharded)")
     if args.fleet:
         bad = [f for f, v in (("--tracker", args.tracker),
                               ("--summary", args.summary),
@@ -714,7 +929,112 @@ def main(argv=None) -> int:
     controller = None
     guard = None
 
+    from shadow1_tpu import mem
     from shadow1_tpu.txn import CapacityExceededError
+
+    def _memory_exit(e: mem.MemoryBudgetError) -> int:
+        """Pre-flight budget rejection: full advice on stderr, one
+        parseable JSON error record on stdout, the dedicated exit code the
+        supervisor classifies as deterministic (no respawn) — the exact
+        shape of the capacity-halt taxonomy (docs/SEMANTICS.md)."""
+        print(f"MemoryBudgetError: {e}", file=sys.stderr, flush=True)
+        print(json.dumps({
+            "error": "memory_budget",
+            "estimated": e.estimated,
+            "budget": e.budget,
+            "budget_source": e.budget_source,
+            "planes": e.planes,
+            "peaks": e.peaks,
+            "advice": e.advice,
+        }))
+        return EXIT_MEMORY
+
+    def _memory_exit_runtime(e, phase: str = "run") -> int:
+        """Runtime OOM taxonomy: a RESOURCE_EXHAUSTED that slipped past
+        the estimate (transients beyond the model, concurrent tenants)
+        still exits structured — phase-tagged record on stdout, pointer at
+        the estimator's advice on stderr, EXIT_MEMORY for the supervisor's
+        deterministic classification."""
+        phase = getattr(e, "shadow1_oom_phase", phase)
+        print(f"[mem] device memory exhausted during {phase}: "
+              f"{str(e)[:2000]}", file=sys.stderr, flush=True)
+        print(f"[mem] deterministic config-vs-device condition — rerun "
+              f"with --on-oom downshift, shrink the dominant plane (the "
+              f"mem record above attributes bytes per plane), or probe "
+              f"the feasible envelope: python -m "
+              f"shadow1_tpu.tools.memprobe {args.config} --maxfit",
+              file=sys.stderr, flush=True)
+        print(json.dumps({
+            "error": "memory_exhausted",
+            "phase": phase,
+            "message": str(e)[:500],
+        }))
+        return EXIT_MEMORY
+
+    # ---- pre-flight memory budget (shadow1_tpu/mem.py) -------------------
+    # Estimate the full device-byte footprint from the config ALONE (an
+    # abstract trace — no state-sized allocation) and compare it against
+    # the backend's reported memory before any compile is attempted. The
+    # one parseable ``mem`` record per run feeds heartbeat_report's memory
+    # section; the estimate failing soft (warning) can never block a
+    # runnable config.
+    sub_batch = None
+    pre_downshift_retry = False  # retry demoted by a memory downshift
+    if engine_kind != "cpu":
+        import os as _osm
+
+        n_exp = len(fleet_plan.exps) if args.fleet else 1
+        n_dev = 1
+        if engine_kind == "sharded":
+            import jax as _jaxm
+
+            n_dev = len(_jaxm.devices())
+        est_exp = fleet_plan.exps[0] if args.fleet else exp
+        budget, budget_src = mem.device_budget()
+        mem_est = None
+        try:
+            mem_est = mem.estimate(est_exp, params, n_exp=n_exp,
+                                   n_dev=n_dev)
+        except Exception as est_err:  # noqa: BLE001 — estimator fails soft
+            log.warning("memory estimate unavailable", error=repr(est_err))
+        if mem_est is not None:
+            print(json.dumps(mem_est.record(budget, budget_src,
+                                            engine=engine_kind)),
+                  file=sys.stderr, flush=True)
+            if budget is not None and mem_est.peak_bytes > budget:
+                if args.on_oom == "downshift":
+                    try:
+                        pre_downshift_retry = params.on_overflow == "retry"
+                        # --save-state gates like --ckpt/--resume: a
+                        # shrunk ring would write a snapshot no
+                        # same-config engine could load back, and a
+                        # sub-batched fleet has no all-lane state to save.
+                        params, sub_batch, ds_actions = mem.downshift(
+                            est_exp, params, n_exp, budget, n_dev=n_dev,
+                            resumable=bool(args.ckpt or args.resume
+                                           or args.save_state))
+                    except mem.MemoryBudgetError as e:
+                        return _memory_exit(e)
+                    ds_est = mem.estimate(est_exp, params,
+                                          n_exp=sub_batch or n_exp,
+                                          n_dev=n_dev)
+                    print(json.dumps(mem.downshift_record(
+                        ds_actions, ds_est.peak_bytes, budget)),
+                        file=sys.stderr, flush=True)
+                    for a in ds_actions:
+                        log.warning("memory downshift", **a)
+                else:
+                    try:
+                        mem.check_budget(mem_est, budget, budget_src)
+                    except mem.MemoryBudgetError as e:
+                        return _memory_exit(e)
+        if _osm.environ.get("SHADOW1_MEM_INJECT_OOM") == "raw":
+            # Test hook for the supervisor's belt-and-braces stderr scan:
+            # die like a crash the structured taxonomy never saw — raw
+            # marker on stderr, generic nonzero exit.
+            print("FATAL: RESOURCE_EXHAUSTED: injected raw OOM (test hook)",
+                  file=sys.stderr, flush=True)
+            raise SystemExit(1)
 
     def _capacity_exit(e: CapacityExceededError) -> int:
         """Structured halt: full advice on stderr, one parseable JSON error
@@ -760,7 +1080,8 @@ def main(argv=None) -> int:
 
         try:
             return _fleet_main(args, params, fleet_plan, log, t0,
-                               _capacity_exit, _preempted_exit)
+                               _capacity_exit, _preempted_exit,
+                               _memory_exit_runtime, sub_batch=sub_batch)
         except FleetConfigError as e:
             # Late rejections (FleetEngine construction) use the same
             # structured exit as the early validation block above.
@@ -802,7 +1123,15 @@ def main(argv=None) -> int:
             from shadow1_tpu.shard.engine import ShardedEngine as Eng
         else:
             from shadow1_tpu.core.engine import Engine as Eng
-        eng = Eng(exp, params)
+        try:
+            eng = Eng(exp, params)
+        except Exception as e:
+            # Engine construction allocates the ctx constants (and the
+            # restart capture) — an exhaustion here maps to the memory
+            # taxonomy like everything later.
+            if mem.is_oom(e):
+                return _memory_exit_runtime(e, phase="init")
+            raise
         st = None
         # A --ckpt snapshot on disk wins over --resume: it is the newer
         # state a supervised respawn must continue from. The lineage
@@ -822,7 +1151,12 @@ def main(argv=None) -> int:
             params0, eng0 = params, eng
             try:
                 template = eng.init_state()
-                if auto_caps or params.on_overflow == "retry":
+                if (auto_caps or params.on_overflow == "retry"
+                        or pre_downshift_retry):
+                    # pre_downshift_retry: snapshots from BEFORE a memory
+                    # downshift demoted retry→halt may still carry
+                    # retry-grown caps — keep the cap-migration path
+                    # alive across the demotion.
                     # An --auto-caps run checkpoints at whatever cap it had
                     # grown to — and so does an --on-overflow retry run
                     # (retry-driven grows stick); a host may hold more
@@ -896,6 +1230,9 @@ def main(argv=None) -> int:
 
         try:
             with prof:
+                if os.environ.get("SHADOW1_MEM_INJECT_OOM") == "run":
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected (test hook)")
                 # phases covers --profile too: its phases.trace.json must
                 # carry real spans, so any profiled run routes through the
                 # instrumented chunk runner. --auto-caps needs the chunked
@@ -939,6 +1276,12 @@ def main(argv=None) -> int:
             return _capacity_exit(e)
         except PreemptedExit as e:
             return _preempted_exit(e, resumed=bool(resume_path))
+        except Exception as e:
+            # Runtime OOM taxonomy: the compile warmup tags its own phase
+            # (obs.py); anything later is a run-time allocation failure.
+            if mem.is_oom(e):
+                return _memory_exit_runtime(e)
+            raise
         if phases is not None:
             if args.trace:
                 phases.write(args.trace)
@@ -949,6 +1292,18 @@ def main(argv=None) -> int:
             from shadow1_tpu.ckpt import save_state
 
             save_state(st, args.save_state)
+        # Close the memory loop: when the backend reports its measured
+        # allocation high-water (TPU/GPU memory_stats), one final mem
+        # record pairs it with the pre-flight estimate —
+        # heartbeat_report's memory section prints estimated vs reported.
+        peak_in_use = mem.device_peak_in_use()
+        if peak_in_use is not None:
+            print(json.dumps({
+                "type": "mem", "event": "final",
+                "peak_in_use": peak_in_use,
+                "estimated_peak": (mem_est.peak_bytes
+                                   if mem_est is not None else None),
+            }), file=sys.stderr, flush=True)
         metrics = Eng.metrics_dict(st)
         summary = eng.model_summary(st)
         n_windows = args.windows if args.windows is not None else eng.n_windows

@@ -35,6 +35,13 @@ EXIT_HUNG = 6        # supervisor abort: the child's progress sidecar went
                      # forward progress — a deterministic wedge, not a
                      # transient device fault (see the no-kill probe
                      # playbook: tools/faultprobe)
+EXIT_MEMORY = 7      # memory plane (shadow1_tpu/mem.py): the pre-flight
+                     # byte budget rejected an oversubscribed config
+                     # (MemoryBudgetError, per-plane attribution + paste-
+                     # ready advice printed), or the runtime caught a
+                     # RESOURCE_EXHAUSTED device OOM — either way a
+                     # deterministic config-vs-device condition the
+                     # supervisor never respawns into
 
 EXIT_CODES: dict[int, str] = {
     EXIT_OK: "ok",
@@ -42,6 +49,7 @@ EXIT_CODES: dict[int, str] = {
     EXIT_CAPACITY: "capacity halt (CapacityExceededError, advice printed)",
     EXIT_PREEMPTED: "preempted (graceful drain; resume to continue)",
     EXIT_HUNG: "hung (watchdog killed a stale child twice, no progress)",
+    EXIT_MEMORY: "memory (over HBM budget / RESOURCE_EXHAUSTED, advice printed)",
 }
 
 # --------------------------------------------------------------------------

@@ -77,14 +77,17 @@ def fleet_metrics_per_exp(st: SimState) -> list[dict[str, int]]:
     return [{k: int(v[e]) for k, v in arrs.items()} for e in range(n)]
 
 
-def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0
-                      ) -> list[dict]:
+def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0,
+                      exp_base: int = 0) -> list[dict]:
     """Per-experiment telemetry-ring drain: the solo ``drain_ring`` per
     lane, each record tagged with its experiment id (``exp``) — the shape
     tools/heartbeat_report.py and captune group by (docs/OBSERVABILITY.md
-    §fleet). TWO device→host fetches total (the [E, W, F] ring and the
-    window counters), then pure numpy lane views — never a per-lane slice
-    of the whole fleet state."""
+    §fleet). ``exp_base`` offsets the ids: a memory-downshifted sub-batch
+    (cli --on-oom downshift) runs lanes [base, base+k) of the sweep, and
+    its ring records must carry the SWEEP-global experiment ids. TWO
+    device→host fetches total (the [E, W, F] ring and the window
+    counters), then pure numpy lane views — never a per-lane slice of the
+    whole fleet state."""
     from types import SimpleNamespace
 
     from shadow1_tpu.telemetry.ring import drain_ring
@@ -100,7 +103,7 @@ def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0
             metrics=SimpleNamespace(windows=int(windows[e])),
         )
         for r in drain_ring(lane, window_ns, start=start):
-            recs.append({**r, "exp": e})
+            recs.append({**r, "exp": e + exp_base})
     return recs
 
 
@@ -204,6 +207,9 @@ class FleetEngine:
             raise FleetConfigError(
                 f"max_rounds list ({len(self.max_rounds)}) != experiment "
                 f"count ({self.n_exp})")
+        # Sweep-global id of lane 0 — nonzero only for a memory-downshifted
+        # sub-batch (cli --on-oom downshift), so records keep global ids.
+        self.exp_base = 0
         self._model = _model_module(self.exp.model)
         self._base_ctx = build_base_ctx(self.exp, self.params,
                                         window=self.window)
@@ -388,7 +394,8 @@ class FleetEngine:
         return out
 
     def drain_rings(self, st: SimState, start: int = 0) -> list[dict]:
-        return drain_fleet_rings(st, self.window, start=start)
+        return drain_fleet_rings(st, self.window, start=start,
+                                 exp_base=self.exp_base)
 
     def model_summary(self, st: SimState, e: int) -> dict[str, Any]:
         lane = slice_experiment(st, e)

@@ -161,7 +161,16 @@ def run_fleet(engine, st=None, n_windows=None, every_windows=None,
         every_windows = max(total // 10, 1)
     if st is None:
         st = engine.init_state()
-    jax.block_until_ready(engine.run(st, n_windows=0))
+    try:
+        jax.block_until_ready(engine.run(st, n_windows=0))
+    except Exception as e:
+        from shadow1_tpu import mem
+
+        # OOM taxonomy: this warmup is the compile — tag exhaustion here
+        # so the CLI's memory record reports the phase (mem.py).
+        if mem.is_oom(e):
+            e.shadow1_oom_phase = "compile"
+        raise
     hb = FleetHeartbeat(engine, stream=stream, initial_state=st,
                         emit_heartbeat=emit_heartbeat, emit_ring=emit_ring)
     halt = engine.params.on_overflow == "halt"

@@ -1,0 +1,587 @@
+"""Memory plane — pre-flight HBM budgeting, OOM taxonomy, downshift planning.
+
+The ROADMAP's scale levers (256k–1M-host dense ladders, wide fleet sweeps)
+all grow the state planes toward the device-memory wall, and an
+oversubscribed config today dies with a raw ``XlaRuntimeError:
+RESOURCE_EXHAUSTED`` mid-compile — after minutes of trace time — and the
+supervisor respawns it straight into the same wall. This module makes
+memory a *structured* failure domain, completing the taxonomy (capacity →
+PR 5, faults → PR 4, preemption → PR 7, memory → this):
+
+* **pre-flight estimator** (:func:`estimate`): the engine state pytree is
+  traced ABSTRACTLY with ``jax.eval_shape`` over the real model-init path
+  (no device allocation — jnp ops on constants stage instead of
+  executing), so the per-leaf shapes/dtypes are exactly what
+  ``Engine.init_state`` would allocate: caps/H/E/ring-W in, bytes out.
+  On top of the resident state ride the known peaks: the non-donated run
+  output (a second full state copy during execution), the transactional
+  rollback copy (``--on-overflow retry`` keeps the chunk-start state),
+  and the window-end routing/rebase temporaries.
+* **budget check** (:func:`check_budget`): estimate vs the backend's
+  reported device memory (``memory_stats()['bytes_limit']``; env
+  ``SHADOW1_MEM_BYTES`` overrides — the CPU backend reports nothing).
+  Over budget ⇒ :class:`MemoryBudgetError` with per-plane byte
+  attribution and paste-ready ``engine:``/``sweep:`` advice, BEFORE any
+  compile is attempted — mirroring ``txn.CapacityExceededError``.
+* **downshift planner** (:func:`downshift` — CLI ``--on-oom downshift``):
+  graceful degradation in bit-exactness-preserving order: drop the txn
+  rollback copy (``retry`` demotes to ``halt`` — replay needs the copy),
+  shrink the telemetry ring W (observability only; the simulation never
+  reads the ring), and split a fleet's E lanes into sequential
+  sub-batches (lanes are independent — vmap batches identical integer
+  ops — so sub-batching is digest-neutral per lane;
+  ``tools/memprobe.py --subbatch`` is the chaosprobe-style proof).
+* **runtime taxonomy** (:func:`is_oom`): a RESOURCE_EXHAUSTED that slips
+  past the estimate (transients beyond the model, concurrent tenants) is
+  caught by the CLI, mapped to ``consts.EXIT_MEMORY`` with a parseable
+  stdout record, and classified deterministic by ``cli._supervise`` — no
+  crash-loop through the backoff ladder.
+
+Accuracy contract: :func:`estimate`'s ``state``/``resident`` bytes must
+track ``jax.live_arrays()`` within 10% on every ladder config —
+``tools/memprobe.py --audit`` measures it, ``tests/test_mem.py`` gates it.
+
+jax is imported lazily inside the estimator functions so the error types
+and record helpers stay importable by jax-free report tools.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+from shadow1_tpu.consts import EXIT_MEMORY, NP  # noqa: F401 (re-export)
+
+# Env override for the device byte budget (integer bytes). Wins over the
+# backend's reported memory — the deterministic knob ci.sh and the tests
+# use on the CPU backend, which reports no memory_stats at all.
+MEM_BYTES_ENV = "SHADOW1_MEM_BYTES"
+
+# Fallback audit tolerance (estimator vs measured live bytes) — the
+# acceptance bound memprobe and the tests check against.
+AUDIT_TOLERANCE = 0.10
+
+# Bytes of flat routing temporaries per outbox slot (route_outbox): the
+# [P*H] flattened arrival/tb/depart i64 columns, the dst/kind/ctr/mask i32
+# columns, and the [NP, P*H] payload view — the window-end working set that
+# exists alongside the state during delivery.
+_ROUTE_BYTES_PER_SLOT = 3 * 8 + 4 * 4 + 4 * NP
+
+
+class MemoryBudgetError(RuntimeError):
+    """The pre-flight byte estimate exceeds the device memory budget.
+
+    Structured: ``estimated`` (peak bytes), ``budget`` (device bytes),
+    ``budget_source`` ("env" or "backend"), ``planes`` (resident per-plane
+    byte attribution), ``peaks`` (transient adders), ``advice`` (the
+    paste-ready remedy block). Raised BEFORE compile, so an oversubscribed
+    config costs milliseconds, not minutes of trace time plus a crash."""
+
+    def __init__(self, estimated: int, budget: int, planes: dict,
+                 peaks: dict, advice: str, budget_source: str = "env",
+                 detail: str = ""):
+        self.estimated = int(estimated)
+        self.budget = int(budget)
+        self.budget_source = budget_source
+        self.planes = {k: int(v) for k, v in planes.items()}
+        self.peaks = {k: int(v) for k, v in peaks.items()}
+        self.advice = advice
+        attribution = "  ".join(
+            f"{k}={fmt_bytes(v)}" for k, v in
+            sorted({**self.planes, **self.peaks}.items(),
+                   key=lambda kv: -kv[1]) if v)
+        super().__init__(
+            f"estimated peak device memory {fmt_bytes(self.estimated)} "
+            f"exceeds the {budget_source} budget {fmt_bytes(self.budget)}"
+            f"{detail} — rejected before compile (an oversubscribed config "
+            f"would otherwise burn minutes of trace time and die with a raw "
+            f"RESOURCE_EXHAUSTED). Plane attribution: {attribution}.\n"
+            f"{advice}"
+        )
+
+
+def fmt_bytes(n) -> str:
+    """Human-readable bytes; shared by the error messages, advice blocks
+    and the jax-free report tools (heartbeat_report). None → "?"."""
+    if n is None:
+        return "?"
+    n = int(n)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def is_oom(e: BaseException) -> bool:
+    """Is this exception a device out-of-memory condition?
+
+    Matches the XLA runtime's RESOURCE_EXHAUSTED (compile-time buffer
+    assignment or run-time allocation) and Python's MemoryError. Our own
+    structured errors never match — they are handled by type."""
+    if isinstance(e, MemoryBudgetError):
+        return False
+    return isinstance(e, MemoryError) or "RESOURCE_EXHAUSTED" in str(e)
+
+
+def device_budget() -> tuple[int | None, str | None]:
+    """The device byte budget: (bytes, source) or (None, None).
+
+    ``SHADOW1_MEM_BYTES`` (integer bytes) wins — the deterministic CI/test
+    knob. Otherwise the backend's reported limit
+    (``Device.memory_stats()['bytes_limit']`` — present on TPU/GPU, absent
+    on the CPU backend, where the estimate is informational only)."""
+    env = os.environ.get(MEM_BYTES_ENV)
+    if env:
+        try:
+            return int(env), "env"
+        except ValueError:
+            import sys
+
+            # Budget discovery must never kill a run: a malformed
+            # override ("8GiB", "8<<30") is announced and ignored, not a
+            # crash-loop through the supervisor's backoff ladder.
+            print(f"[mem] ignoring malformed {MEM_BYTES_ENV}={env!r} "
+                  f"(integer bytes expected)", file=sys.stderr, flush=True)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"]), "backend"
+    except Exception:  # noqa: BLE001 — budget discovery must never kill a run
+        pass
+    return None, None
+
+
+def device_peak_in_use() -> int | None:
+    """The backend's measured high-water allocation, when it reports one
+    (``peak_bytes_in_use``) — the comparand for the end-of-run mem record."""
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            v = stats.get("peak_bytes_in_use")
+            return int(v) if v is not None else None
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def live_bytes() -> int:
+    """Bytes of every live device BUFFER in this process — the measured
+    side of the estimator audit (memprobe/tests). Deduplicated by buffer
+    pointer: on the CPU backend a host↔device round-trip (e.g. the restart
+    capture's ``jnp.asarray(np.asarray(x))``) can alias the same memory
+    under two Array objects, and counting both would double-charge bytes
+    the device only holds once."""
+    import jax
+
+    seen: set = set()
+    tot = 0
+    for a in jax.live_arrays():
+        try:
+            key = a.unsafe_buffer_pointer()
+        except Exception:  # noqa: BLE001 — sharded/committed arrays
+            key = id(a)
+        if key in seen:
+            continue
+        seen.add(key)
+        tot += int(a.nbytes)
+    return tot
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree of arrays / ShapeDtypeStructs."""
+    import jax
+    import numpy as np
+
+    tot = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            tot += int(leaf.nbytes)
+        elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            tot += int(np.dtype(leaf.dtype).itemsize
+                       * int(np.prod(leaf.shape, dtype=np.int64)))
+    return tot
+
+
+def abstract_state(exp, params):
+    """(abstract SimState, eager Ctx) for one experiment lane.
+
+    The Ctx is built EAGERLY — its topology/fault tables are O(V²)+O(K·H)
+    device constants, small next to the [C, H] state planes and needed as
+    concrete arrays anyway — while the state construction (the [C, H]
+    planes, the model pytree, the telemetry ring) is traced under
+    ``jax.eval_shape``: jnp ops stage into the jaxpr instead of executing,
+    so NO state-sized allocation happens. The returned shapes/dtypes are
+    exactly ``Engine.init_state()``'s, model init path included — which is
+    what makes the estimator exact rather than a drift-prone mirror of the
+    plane layouts."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow1_tpu.core.engine import (
+        SimState,
+        _metrics_init,
+        _model_module,
+        build_base_ctx,
+    )
+    from shadow1_tpu.core.events import evbuf_init
+    from shadow1_tpu.core.outbox import outbox_init
+    from shadow1_tpu.telemetry.ring import ring_init
+
+    ctx = build_base_ctx(exp, params)
+    mod = _model_module(exp.model)
+
+    def build():
+        evbuf = evbuf_init(exp.n_hosts, params.ev_cap)
+        model, evbuf, seed_over = mod.init(ctx, evbuf)
+        metrics = _metrics_init()
+        return SimState(
+            win_start=jnp.zeros((), jnp.int64),
+            evbuf=evbuf,
+            outbox=outbox_init(exp.n_hosts, params.outbox_cap),
+            model=model,
+            metrics=metrics._replace(
+                ev_overflow=metrics.ev_overflow + seed_over),
+            cpu_busy=jnp.zeros(exp.n_hosts, jnp.int64),
+            telem=ring_init(params.metrics_ring),
+        )
+
+    return jax.eval_shape(build), ctx
+
+
+def _ctx_bytes(ctx) -> int:
+    """Bytes of the Ctx's device-constant tables (topology, fault plane,
+    fidelity knobs, model_cfg arrays) — closed over by the jitted program
+    and resident for the engine's lifetime."""
+    tot = 0
+    for f in dataclasses.fields(ctx):
+        tot += tree_bytes(getattr(ctx, f.name))
+    return tot
+
+
+def _variant_lane_bytes(ctx) -> int:
+    """Per-lane bytes of the fleet variant pytree (fleet/engine.py
+    _build_variants): RNG key, loss thresholds, fault tables. Lane tables
+    pad to the sweep-wide max shape, so this lane-0 figure is a floor —
+    the audit tolerance absorbs the padding."""
+    return tree_bytes((ctx.key, ctx.loss_thr_vv, ctx.fault_down,
+                       ctx.fault_up, ctx.link_fault, ctx.loss_ramp))
+
+
+@dataclasses.dataclass
+class MemEstimate:
+    """The pre-flight memory model of one run configuration.
+
+    ``planes`` are RESIDENT bytes (alive for the run: the state pytree per
+    plane, ctx constants, fleet variants, the restart capture); ``peaks``
+    are TRANSIENT adders that coexist with the residents at the worst
+    moment (the run-output state copy, the txn rollback copy, the
+    window-end routing/rebase temporaries). The budget compares
+    ``peak_bytes``; the live-bytes audit compares ``resident_bytes``."""
+
+    planes: dict
+    peaks: dict
+    n_exp: int
+    n_dev: int
+    params: object
+    # linear unit costs for advice / downshift math
+    evbuf_per_cap: int      # bytes per ev_cap step (whole fleet, per dev)
+    outbox_per_cap: int
+    telem_per_w: int        # bytes per ring window (whole fleet)
+    lane_bytes: int         # peak bytes proportional to one fleet lane
+    fixed_bytes: int        # peak bytes independent of the lane count
+
+    @property
+    def state_bytes(self) -> int:
+        return sum(v for k, v in self.planes.items()
+                   if k not in ("const", "variants", "init_model"))
+
+    @property
+    def resident_bytes(self) -> int:
+        return sum(self.planes.values())
+
+    @property
+    def peak_bytes(self) -> int:
+        return self.resident_bytes + sum(self.peaks.values())
+
+    def max_lanes(self, budget: int) -> int:
+        """Largest fleet sub-batch E' whose peak fits ``budget`` (≥ 0)."""
+        if self.lane_bytes <= 0:
+            return self.n_exp
+        return max(int(budget) - self.fixed_bytes, 0) // self.lane_bytes
+
+    def record(self, budget: int | None = None,
+               budget_source: str | None = None, **extra) -> dict:
+        """The parseable ``mem`` JSONL record (docs/OBSERVABILITY.md)."""
+        rec = {
+            "type": "mem",
+            "event": "estimate",
+            "n_exp": self.n_exp,
+            "n_dev": self.n_dev,
+            "estimated_state": self.state_bytes,
+            "estimated_resident": self.resident_bytes,
+            "estimated_peak": self.peak_bytes,
+            "budget": budget,
+            "budget_source": budget_source,
+            "headroom": (int(budget) - self.peak_bytes
+                         if budget is not None else None),
+            "planes": {k: int(v) for k, v in self.planes.items()},
+            "peaks": {k: int(v) for k, v in self.peaks.items()},
+        }
+        rec.update(extra)
+        return rec
+
+    # -- advice ------------------------------------------------------------
+    def advice(self, budget: int) -> str:
+        """Paste-ready remedy block: ranked plane attribution, concrete
+        knob values computed by linear scaling (evbuf ∝ ev_cap, ring ∝ W,
+        fleet ∝ E), and the structural remedies (--on-oom downshift,
+        --on-overflow halt). The captune idiom: every suggestion is a line
+        the operator can paste, never just 'use less memory'."""
+        from shadow1_tpu.tune.ladder import LADDER_MIN, cap_ladder
+
+        budget = int(budget)
+        over = self.peak_bytes - budget
+        p = self.params
+        lines = [f"Peak over budget by {fmt_bytes(over)}. Remedies "
+                 f"(largest planes first):"]
+        ranked = sorted({**self.planes, **self.peaks}.items(),
+                        key=lambda kv: -kv[1])
+        attribution = ", ".join(f"{k} {fmt_bytes(v)}"
+                                for k, v in ranked if v)
+        lines.append(f"  planes: {attribution}")
+        if self.peaks.get("rollback"):
+            lines.append(f"  --on-overflow halt  # frees the transactional "
+                         f"rollback copy: {fmt_bytes(self.peaks['rollback'])}")
+        if self.planes.get("telem"):
+            spare = budget - (self.peak_bytes - self.planes["telem"])
+            w_fit = max(spare, 0) // max(self.telem_per_w, 1)
+            if 0 < w_fit < p.metrics_ring:
+                lines.append(f"  --metrics-ring {w_fit}  # ring "
+                             f"{p.metrics_ring}→{w_fit} windows frees "
+                             f"{fmt_bytes((p.metrics_ring - w_fit) * self.telem_per_w)}")
+        # Cap ladder-down: the largest ladder cap whose scaled peak fits.
+        # The output copy holds a second plane and the window temporaries
+        # shrink with it too — count the plane's share of both, 2x.
+        eng_lines = []
+        residual = self.peak_bytes
+        for knob, cur, per in (("ev_cap", p.ev_cap, self.evbuf_per_cap),
+                               ("outbox_cap", p.outbox_cap,
+                                self.outbox_per_cap)):
+            ladder = [c for c in cap_ladder(cur) if LADDER_MIN <= c < cur]
+            pick = None
+            for cap in reversed(ladder):  # largest reduction last resort
+                pick = cap
+                if residual - 2 * (cur - cap) * per <= budget:
+                    break
+            if pick is not None:
+                residual -= 2 * (cur - pick) * per
+                eng_lines.append(f"    {knob}: {pick}  # from {cur}")
+        if eng_lines and residual <= budget:
+            lines.append("  engine:  # size precisely from a recorded "
+                         "run: python -m shadow1_tpu.tools.captune")
+            lines.extend(eng_lines)
+        if self.n_exp > 1:
+            k = self.max_lanes(budget)
+            if 1 <= k < self.n_exp:
+                lines.append(f"  sweep: run <= {k} lane(s) per batch "
+                             f"(--on-oom downshift sub-batches the "
+                             f"{self.n_exp}-lane fleet automatically, "
+                             f"bit-identically per lane)")
+        lines.append("  or rerun with --on-oom downshift (rollback drop → "
+                     "ring shrink → fleet sub-batch, in that order); "
+                     "probe the feasible envelope: python -m "
+                     "shadow1_tpu.tools.memprobe <config> --maxfit")
+        return "\n".join(lines)
+
+
+def estimate(exp, params, n_exp: int = 1, n_dev: int = 1) -> MemEstimate:
+    """The pre-flight byte estimate for one run configuration.
+
+    ``n_exp`` > 1 models a fleet (every state leaf ×E, plus the stacked
+    variant tables); ``n_dev`` > 1 models host-axis sharding (the [.., H]
+    planes divide across devices; metrics/ring/scalars replicate — the
+    budget is per device). The state side is EXACT (abstract trace of the
+    real init); the const/variant/transient sides are explicit models
+    documented in docs/SEMANTICS.md §"Memory contract"."""
+    st, ctx = abstract_state(exp, params)
+    E, D = int(n_exp), max(int(n_dev), 1)
+
+    def sharded(n):  # host-axis planes divide across devices
+        return -(-int(n) * E // D)
+
+    per = {f: tree_bytes(getattr(st, f))
+           for f in ("evbuf", "outbox", "model", "metrics", "cpu_busy",
+                     "telem")}
+    scalars = tree_bytes(st.win_start)
+    planes = {
+        "evbuf": sharded(per["evbuf"]),
+        "outbox": sharded(per["outbox"]),
+        "model": sharded(per["model"]),
+        "metrics": per["metrics"] * E,
+        "telem": per["telem"] * E,
+        "scalars": (per["cpu_busy"] + scalars) * E,
+        "const": _ctx_bytes(ctx),
+    }
+    if E > 1:
+        planes["variants"] = _variant_lane_bytes(ctx) * E
+    if ctx.has_restart:
+        # The restart capture (init_model): a full model pytree held as a
+        # device constant (per lane under fleet).
+        planes["init_model"] = sharded(per["model"])
+    state = sum(v for k, v in planes.items()
+                if k not in ("const", "variants", "init_model"))
+    # Transients: the non-donated run output (a full second state during
+    # execution), the txn rollback copy (retry holds the chunk-start state
+    # across the chunk), and the window-end working set (route_outbox's
+    # flattened [P*H] columns + the rebase/digest i64 [C, H] temporaries).
+    route = sharded(params.outbox_cap * exp.n_hosts * _ROUTE_BYTES_PER_SLOT)
+    rebase = sharded(params.ev_cap * exp.n_hosts * 8)
+    peaks = {
+        "output": state,
+        "rollback": state if params.on_overflow == "retry" else 0,
+        "transient": route + rebase,
+    }
+    if D > 1:
+        # Sharded exchange staging: the per-window all_to_all buckets
+        # (send + receive sides) on each device. The guaranteed-fit
+        # escalation cap is the shard's WHOLE outbox (shard/engine.py),
+        # so 2× the per-device outbox plane is the conservative bound.
+        peaks["x2x"] = 2 * planes["outbox"]
+    lane = ((state + peaks["output"] + peaks["rollback"]
+             + peaks["transient"]) // E
+            + planes.get("variants", 0) // max(E, 1)
+            + planes.get("init_model", 0) // E)
+    est = MemEstimate(
+        planes=planes, peaks=peaks, n_exp=E, n_dev=D, params=params,
+        evbuf_per_cap=sharded(per["evbuf"]) // max(params.ev_cap, 1),
+        outbox_per_cap=sharded(per["outbox"]) // max(params.outbox_cap, 1),
+        telem_per_w=(per["telem"] * E) // max(params.metrics_ring, 1)
+        if params.metrics_ring else 0,
+        lane_bytes=lane,
+        fixed_bytes=planes["const"],
+    )
+    return est
+
+
+def check_budget(est: MemEstimate, budget: int | None,
+                 budget_source: str | None = None, detail: str = "") -> None:
+    """Raise :class:`MemoryBudgetError` when the estimated peak exceeds the
+    budget. A None budget (CPU backend, no env override) checks nothing —
+    the estimate is then informational (the ``mem`` record still flows)."""
+    if budget is None or est.peak_bytes <= int(budget):
+        return
+    raise MemoryBudgetError(
+        estimated=est.peak_bytes, budget=budget, planes=est.planes,
+        peaks=est.peaks, advice=est.advice(budget),
+        budget_source=budget_source or "env", detail=detail)
+
+
+def downshift(exp, params, n_exp: int, budget: int, n_dev: int = 1,
+              resumable: bool = False):
+    """The graceful-degradation planner (CLI ``--on-oom downshift``).
+
+    Applies the bit-exactness-preserving downshifts IN ORDER until the
+    estimated peak fits ``budget``:
+
+    1. **drop the rollback copy** — ``on_overflow=retry`` demotes to
+       ``halt`` (the replay NEEDS the chunk-start copy, so retry cannot be
+       kept without its memory; halt keeps overflow loud instead of lossy);
+    2. **shrink the telemetry ring** — the simulation never reads the ring
+       (observability only), so a narrower W changes which windows get
+       per-window records, never any digest word that IS recorded
+       (``state_digest`` keeps W ≥ 1: the words need a transport).
+       SKIPPED when ``resumable`` (--ckpt/--resume): the ring is a state
+       leaf, so a shrunk W could not load snapshots taken at the original
+       width — a budget change against an existing lineage would then
+       crash-loop on a shape mismatch instead of downshifting;
+    3. **sub-batch the fleet** — split E lanes into sequential batches of
+       the largest k that fits; lanes are independent, so each lane's
+       digest stream/metrics are bit-identical to the full-E run
+       (tools/memprobe.py --subbatch proves it per invocation). Refused
+       when ``resumable``: a sub-batched sweep has no single all-lane
+       snapshot to resume from.
+
+    The rollback drop is the one stage always available: it frees a
+    transient copy, never a state leaf, so snapshots stay loadable (the
+    CLI keeps the retry-era cap-migration path alive across the
+    demotion).
+
+    Returns ``(params', sub_batch_or_None, actions)`` — ``actions`` is the
+    audit list the ``mem`` downshift record carries. Raises
+    :class:`MemoryBudgetError` when every downshift is exhausted and the
+    estimate still exceeds the budget."""
+    budget = int(budget)
+    actions: list[dict] = []
+    est = estimate(exp, params, n_exp=n_exp, n_dev=n_dev)
+    if est.peak_bytes <= budget:
+        return params, None, actions
+    if params.on_overflow == "retry":
+        freed = est.peaks["rollback"]
+        params = dataclasses.replace(params, on_overflow="halt")
+        actions.append({"action": "drop_rollback", "on_overflow": "halt",
+                        "freed": int(freed)})
+        est = estimate(exp, params, n_exp=n_exp, n_dev=n_dev)
+    if est.peak_bytes > budget and params.metrics_ring > 0 and not resumable:
+        min_w = 1 if params.state_digest else 0
+        # Each removed ring window frees its state row AND the row's share
+        # of the non-donated run-output copy — 2× telem_per_w — so divide
+        # by both or the ring over-shrinks (observability sacrificed for
+        # nothing). The re-estimate below is the exact check; one more
+        # exact pass handles any rounding shortfall.
+        row = 2 * max(est.telem_per_w, 1)
+        w0 = params.metrics_ring
+        w_new = w0
+        for _ in range(2):
+            need = est.peak_bytes - budget
+            if need <= 0 or w_new <= min_w:
+                break
+            w_new = max(min_w, w_new - math.ceil(need / row))
+            params = dataclasses.replace(params, metrics_ring=w_new)
+            est = estimate(exp, params, n_exp=n_exp, n_dev=n_dev)
+        if w_new < w0:
+            actions.append({"action": "shrink_ring",
+                            "metrics_ring": [w0, w_new],
+                            "freed": int((w0 - w_new) * row)})
+    sub_batch = None
+    if est.peak_bytes > budget and n_exp > 1:
+        k = est.max_lanes(budget)
+        if 1 <= k < n_exp:
+            if resumable:
+                raise MemoryBudgetError(
+                    estimated=est.peak_bytes, budget=budget,
+                    planes=est.planes, peaks=est.peaks,
+                    advice=est.advice(budget),
+                    detail=" (sub-batched downshift does not compose with "
+                           "--ckpt/--resume: a sub-batched sweep has no "
+                           "single all-lane snapshot — drop the checkpoint "
+                           "flags or shrink the sweep)")
+            sub_batch = k
+            actions.append({"action": "sub_batch", "lanes": k,
+                            "batches": -(-n_exp // k)})
+            # By max_lanes construction a k-lane batch fits the budget.
+            est = estimate(exp, params, n_exp=k, n_dev=n_dev)
+    if est.peak_bytes > budget:
+        detail = (" (the state-shape-preserving downshifts are exhausted: "
+                  "rollback dropped — ring shrink and sub-batching are "
+                  "unavailable under --ckpt/--resume because they change "
+                  "the snapshot shape; drop the checkpoint flags or "
+                  "shrink the config)" if resumable else
+                  " (every --on-oom downshift is exhausted: rollback "
+                  "dropped, ring floored, fleet at one lane — the base "
+                  "state planes alone exceed the device)")
+        raise MemoryBudgetError(
+            estimated=est.peak_bytes, budget=budget, planes=est.planes,
+            peaks=est.peaks, advice=est.advice(budget), detail=detail)
+    return params, sub_batch, actions
+
+
+def downshift_record(actions: list[dict], est_peak: int,
+                     budget: int) -> dict:
+    """The parseable ``mem`` downshift record (docs/OBSERVABILITY.md)."""
+    return {"type": "mem", "event": "downshift", "actions": actions,
+            "estimated_peak": int(est_peak), "budget": int(budget)}

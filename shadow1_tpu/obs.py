@@ -237,7 +237,17 @@ def run_with_heartbeat(engine, st=None, n_windows=None, every_windows=None,
     # zero-window call builds the exact program every chunk reuses — the
     # first heartbeat's events/sec no longer folds compile time in.
     with maybe_span(profiler, PH_COMPILE):
-        jax.block_until_ready(engine.run(st, n_windows=0))
+        try:
+            jax.block_until_ready(engine.run(st, n_windows=0))
+        except Exception as e:
+            from shadow1_tpu import mem
+
+            # OOM taxonomy: an exhaustion here is a COMPILE/allocation
+            # failure, not a mid-run one — tag it so the CLI's memory
+            # record reports the phase truthfully (mem.py).
+            if mem.is_oom(e):
+                e.shadow1_oom_phase = "compile"
+            raise
     hb = Heartbeat(engine, stream=stream, initial_state=st, profiler=profiler,
                    emit_heartbeat=emit_heartbeat, emit_ring=emit_ring,
                    guard=guard)

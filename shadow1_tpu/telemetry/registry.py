@@ -102,9 +102,16 @@ REC_FLEET_SUMMARY = "fleet_summary"
 # summarized by tools/heartbeat_report.py's lineage section.
 REC_RESUME = "resume"
 REC_LINEAGE = "lineage"
+# Memory plane (shadow1_tpu/mem.py): one ``mem`` record per batched run on
+# stderr (event = estimate | downshift | final) — estimated per-plane bytes
+# vs the device budget, applied downshifts, and the backend's measured peak
+# when it reports one. Like the digest/retry columns, mem fields never
+# enter ring percentile math: they are their own record type, summarized by
+# tools/heartbeat_report.py's "memory" section.
+REC_MEM = "mem"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
-                REC_RESUME, REC_LINEAGE)
+                REC_RESUME, REC_LINEAGE, REC_MEM)
 
 # The drop/overflow counter group: every way a modeled event or packet can
 # be discarded, with the human-readable reason. Heartbeat records and the

@@ -1,0 +1,189 @@
+"""Per-PR perf regression gate — the BENCH trajectory, enforced.
+
+    python -m shadow1_tpu.tools.benchgate            # gate vs BENCH_GATE.json
+    python -m shadow1_tpu.tools.benchgate --update   # re-baseline
+
+The telemetry ring and phase profiler RECORD everything, but until now
+nothing ENFORCED the perf trajectory (ROADMAP item 5): a PR could regress
+the round path and tier-1 would stay green. This runs one smoke-sized
+PHOLD row (bench.py's smoke shape: dense windows, chunked) and compares
+**ms per inner round** — the per-round fixed cost that is the paper's
+whole economics — against the committed ``BENCH_GATE.json`` baseline:
+
+* measured > baseline × (1 + tolerance) → exit 1 (the gate fails CI);
+* intentional trade-off? the one-line override:
+  ``SHADOW1_BENCH_GATE_ACCEPT="why" ./ci.sh smoke`` turns the failure
+  into a warning — then commit the new baseline with ``--update`` so the
+  next PR gates against the accepted cost;
+* a big improvement prints a reminder to re-baseline (non-fatal —
+  ratchets tighten deliberately, not by timing luck).
+
+Noise control: the gate times N_CHUNKS chunks after a full compile warmup
+and gates on the MINIMUM chunk wall (per-round), which is stable on a
+shared container where means are not. The tolerance (default 5%) rides in
+the baseline file so a re-baseline can widen it deliberately.
+
+Always prints exactly one JSON line on stdout (the bench.py contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASELINE = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "BENCH_GATE.json")
+
+# bench.py's CPU-smoke shape — big enough that the round path dominates,
+# small enough for seconds of CI wall.
+N_HOSTS = 2048
+CHUNK = 20
+N_CHUNKS = 4
+TOLERANCE = 0.05
+ACCEPT_ENV = "SHADOW1_BENCH_GATE_ACCEPT"
+
+
+def host_fingerprint() -> str:
+    """CPU model + logical core count — a wall-clock baseline only gates
+    meaningfully on the machine class it was measured on."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return f"{model} x{os.cpu_count()}"
+
+
+def measure() -> dict:
+    import jax
+
+    from shadow1_tpu.config.compiled import single_vertex_experiment
+    from shadow1_tpu.consts import MS, EngineParams
+    from shadow1_tpu.core.engine import Engine
+
+    exp = single_vertex_experiment(
+        n_hosts=N_HOSTS, seed=1234, end_time=(N_CHUNKS + 1) * CHUNK * MS,
+        latency_ns=1 * MS, model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 16},
+    )
+    eng = Engine(exp, EngineParams(ev_cap=48, outbox_cap=24,
+                                   max_rounds=128))
+    t0 = time.perf_counter()
+    st = eng.init_state()
+    jax.block_until_ready(eng.run(st, n_windows=CHUNK))
+    compile_wall = time.perf_counter() - t0
+    walls, rounds = [], []
+    for _ in range(N_CHUNKS):
+        r0 = int(st.metrics.rounds)
+        t0 = time.perf_counter()
+        st = eng.run(st, n_windows=CHUNK)
+        jax.block_until_ready(st)
+        walls.append(time.perf_counter() - t0)
+        rounds.append(int(st.metrics.rounds) - r0)
+    # Gate on the minimum PER-ROUND cost, not the minimum-wall chunk: a
+    # chunk can post the smallest wall simply by running fewer rounds.
+    best = min(range(N_CHUNKS),
+               key=lambda i: walls[i] / max(rounds[i], 1))
+    return {
+        "metric": "phold_smoke_ms_per_round",
+        "ms_per_round": round(walls[best] * 1000 / max(rounds[best], 1), 4),
+        "hosts": N_HOSTS,
+        "chunk_windows": CHUNK,
+        "chunks_timed": N_CHUNKS,
+        "rounds_per_chunk": rounds[best],
+        "events": int(st.metrics.events),
+        "compile_wall_s": round(compile_wall, 3),
+        "chunk_walls_s": [round(w, 4) for w in walls],
+        "backend": jax.default_backend(),
+        "host": host_fingerprint(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.benchgate")
+    ap.add_argument("--update", action="store_true",
+                    help="write the measured row as the new committed "
+                         "baseline (BENCH_GATE.json)")
+    ap.add_argument("--baseline", default=BASELINE,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    import shadow1_tpu  # noqa: F401  (x64 before jax arrays)
+    from shadow1_tpu.platform import ensure_live_platform
+
+    ensure_live_platform(min_devices=1)
+    row = measure()
+    if args.update:
+        base = {**row, "tolerance": TOLERANCE,
+                "note": "benchgate baseline — gate fails CI when measured "
+                        "ms_per_round exceeds this by > tolerance; "
+                        "override once with SHADOW1_BENCH_GATE_ACCEPT, "
+                        "then re-baseline with --update"}
+        with open(args.baseline, "w") as f:
+            json.dump(base, f, indent=1)
+            f.write("\n")
+        print(json.dumps({**row, "gate": "updated",
+                          "baseline": args.baseline}))
+        return 0
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+    except OSError:
+        print(json.dumps({**row, "gate": "no_baseline",
+                          "hint": "commit one with --update"}))
+        return 0
+    tol = float(base.get("tolerance", TOLERANCE))
+    ref = float(base["ms_per_round"])
+    ratio = row["ms_per_round"] / ref if ref else 1.0
+    verdict = {**row, "baseline_ms_per_round": ref,
+               "ratio": round(ratio, 4), "tolerance": tol}
+    if base.get("backend") != row["backend"]:
+        # A baseline timed on another backend gates nothing meaningful.
+        print(json.dumps({**verdict, "gate": "skipped_backend_mismatch"}))
+        return 0
+    if base.get("host") and base["host"] != row["host"]:
+        # Same rule for the machine class: a wall-clock baseline from
+        # another CPU would fail every PR on a slower box (or wave real
+        # regressions through on a faster one) with no code change at
+        # all. Re-baseline per machine with --update.
+        print(f"[benchgate] baseline host {base['host']!r} != this host "
+              f"{row['host']!r} — gate skipped; re-baseline here with "
+              f"--update", file=sys.stderr, flush=True)
+        print(json.dumps({**verdict, "gate": "skipped_host_mismatch"}))
+        return 0
+    if ratio > 1 + tol:
+        accept = os.environ.get(ACCEPT_ENV)
+        if accept:
+            print(f"[benchgate] REGRESSION ACCEPTED ({accept}): "
+                  f"{row['ms_per_round']} vs baseline {ref} ms/round "
+                  f"(+{(ratio - 1) * 100:.1f}%) — commit the new baseline: "
+                  f"python -m shadow1_tpu.tools.benchgate --update",
+                  file=sys.stderr, flush=True)
+            print(json.dumps({**verdict, "gate": "accepted",
+                              "reason": accept}))
+            return 0
+        print(f"[benchgate] PERF REGRESSION: {row['ms_per_round']} vs "
+              f"baseline {ref} ms/round (+{(ratio - 1) * 100:.1f}% > "
+              f"{tol * 100:.0f}% tolerance). If intentional, override "
+              f"once: {ACCEPT_ENV}='why' — then re-baseline with "
+              f"--update.", file=sys.stderr, flush=True)
+        print(json.dumps({**verdict, "gate": "failed"}))
+        return 1
+    if ratio < 1 - 2 * tol:
+        print(f"[benchgate] improvement: {row['ms_per_round']} vs "
+              f"baseline {ref} ms/round ({(1 - ratio) * 100:.1f}% faster) "
+              f"— consider tightening the ratchet with --update",
+              file=sys.stderr, flush=True)
+    print(json.dumps({**verdict, "gate": "ok"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

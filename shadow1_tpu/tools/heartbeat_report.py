@@ -24,6 +24,7 @@ from shadow1_tpu.telemetry.registry import (
     REC_FLEET_EXP,
     REC_HEARTBEAT,
     REC_LINEAGE,
+    REC_MEM,
     REC_RESUME,
     REC_RING,
     REC_RING_GAP,
@@ -32,6 +33,10 @@ from shadow1_tpu.telemetry.registry import (
     RING_FIELDS,
     RING_GAUGES,
 )
+
+# jax-free by design (mem.py imports jax only inside the estimator
+# functions) — one byte formatter for every surface, never two drifting.
+from shadow1_tpu.mem import fmt_bytes as _fmt_bytes
 
 
 def load_records(path: str) -> list[dict]:
@@ -197,6 +202,62 @@ def summarize(recs: list[dict], out=None) -> dict:
             print(f"  watchdog kill: sidecar stale > {r.get('stale_s')}s "
                   f"at sim_ns {r.get('sim_ns')} (attempt "
                   f"{r.get('attempt')})", file=out)
+    mems = [r for r in recs if r.get("type") == REC_MEM]
+    ooms = [r for r in recs if r.get("error") in ("memory_budget",
+                                                  "memory_exhausted")]
+    if mems or ooms:
+        # Memory plane (shadow1_tpu/mem.py): estimated vs reported peak
+        # bytes, per-plane attribution, applied downshifts, OOM/budget
+        # errors. Mem records are their own type — like the digest and
+        # retry columns, none of these fields ever enter the ring
+        # percentile math below (only RING_COUNTERS/RING_GAUGES rank).
+        est = next((r for r in mems if r.get("event") == "estimate"), None)
+        downs = [r for r in mems if r.get("event") == "downshift"]
+        final = next((r for r in reversed(mems)
+                      if r.get("event") == "final"), None)
+        msum: dict = {}
+        print("== memory (estimate vs device) ==", file=out)
+        if est is not None:
+            msum.update(estimated_state=est.get("estimated_state"),
+                        estimated_peak=est.get("estimated_peak"),
+                        budget=est.get("budget"),
+                        headroom=est.get("headroom"))
+            print(f"  estimated: state {_fmt_bytes(est.get('estimated_state'))}"
+                  f"  resident {_fmt_bytes(est.get('estimated_resident'))}"
+                  f"  peak {_fmt_bytes(est.get('estimated_peak'))}",
+                  file=out)
+            budget = est.get("budget")
+            if budget is not None:
+                print(f"  budget: {_fmt_bytes(budget)} "
+                      f"({est.get('budget_source')})  headroom: "
+                      f"{_fmt_bytes(est.get('headroom'))}", file=out)
+            planes = {**est.get("planes", {}), **est.get("peaks", {})}
+            for k, v in sorted(planes.items(), key=lambda kv: -kv[1]):
+                if v:
+                    print(f"  {k}: {_fmt_bytes(v)}", file=out)
+        if final is not None and final.get("peak_in_use") is not None:
+            msum["peak_in_use"] = final["peak_in_use"]
+            print(f"  reported peak in use: "
+                  f"{_fmt_bytes(final['peak_in_use'])} (backend) vs "
+                  f"estimated {_fmt_bytes(final.get('estimated_peak'))}",
+                  file=out)
+        for r in downs:
+            msum.setdefault("downshifts", []).extend(r.get("actions", []))
+            acts = ", ".join(a.get("action", "?") for a in
+                             r.get("actions", []))
+            print(f"  downshift applied: {acts} → peak "
+                  f"{_fmt_bytes(r.get('estimated_peak'))} within "
+                  f"{_fmt_bytes(r.get('budget'))}", file=out)
+        for r in ooms:
+            msum.setdefault("errors", []).append(r["error"])
+            where = (f" during {r['phase']}" if r.get("phase") else "")
+            if r["error"] == "memory_budget":
+                detail = (f"estimated {_fmt_bytes(r.get('estimated'))} vs "
+                          f"budget {_fmt_bytes(r.get('budget'))}")
+            else:  # memory_exhausted carries the runtime message instead
+                detail = str(r.get("message", ""))[:160]
+            print(f"  ERROR {r['error']}{where}: {detail}", file=out)
+        summary["memory"] = msum
     if rings:
         # Fleet runs tag each ring row with its experiment id (``exp``):
         # group the per-window stats PER EXPERIMENT — mixing lanes would

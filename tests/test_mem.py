@@ -1,0 +1,503 @@
+"""Memory-safe execution: pre-flight HBM budget, structured OOM taxonomy,
+bit-exact downshift (shadow1_tpu/mem.py).
+
+The contract under test (docs/SEMANTICS.md "Memory contract"):
+
+* the pre-flight estimator's resident bytes track ``jax.live_arrays()``
+  within 10% — solo, fleet E=3, and after an ``--auto-caps``-style
+  resize (the estimator re-runs at the grown caps);
+* an oversubscribed config exits EXIT_MEMORY with per-plane attribution
+  and advice BEFORE compiling, and the supervisor classifies that exit
+  (and a raw RESOURCE_EXHAUSTED crash) as deterministic — no respawn;
+* ``--on-oom downshift`` degrades in bit-exactness-preserving order
+  (rollback drop → ring shrink → fleet sub-batch), and a sub-batched
+  fleet's per-lane digest streams are bit-identical to the full-E run.
+"""
+
+import dataclasses
+import gc
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from shadow1_tpu import mem
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import EXIT_MEMORY, MS, EngineParams
+from shadow1_tpu.core.engine import Engine
+
+
+def phold_exp(n_hosts=16, seed=5, windows=40):
+    return single_vertex_experiment(
+        n_hosts=n_hosts, seed=seed, end_time=windows * MS, latency_ns=1 * MS,
+        model="phold",
+        model_cfg={"mean_delay_ns": float(2 * MS), "init_events": 4},
+    )
+
+
+def _measured_resident(build):
+    """live-bytes delta of whatever ``build()`` returns (held until
+    measured) — the actual side of the estimator audit."""
+    import jax
+
+    gc.collect()
+    base = mem.live_bytes()
+    obj = build()
+    jax.block_until_ready(obj)
+    measured = mem.live_bytes() - base
+    del obj
+    gc.collect()
+    return measured
+
+
+# ---------------------------------------------------------------------------
+# Estimator-vs-actual byte audits (the 10% acceptance bound)
+# ---------------------------------------------------------------------------
+
+def test_estimate_matches_live_bytes_solo():
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16, metrics_ring=10)
+    est = mem.estimate(exp, params)
+
+    def build():
+        eng = Engine(exp, params)
+        return (eng, eng.init_state())
+
+    measured = _measured_resident(build)
+    assert measured > 0
+    ratio = est.resident_bytes / measured
+    assert abs(ratio - 1.0) <= mem.AUDIT_TOLERANCE, (
+        est.resident_bytes, measured)
+
+
+def test_estimate_matches_live_bytes_net_model():
+    import numpy as np
+
+    n = 8
+    exp = single_vertex_experiment(
+        n_hosts=n, seed=3, end_time=20 * MS, latency_ns=1 * MS,
+        model="net", model_cfg={
+            "app": "tgen",
+            "active": np.ones(n, np.int64),
+            "streams": np.full(n, 2, np.int64),
+            "mean_bytes": np.full(n, 20000, np.float64),
+            "mean_think_ns": np.full(n, 50.0 * MS, np.float64),
+            "start_time": np.full(n, 1 * MS, np.int64),
+        },
+    )
+    params = EngineParams(ev_cap=32, outbox_cap=16)
+    est = mem.estimate(exp, params)
+
+    def build():
+        eng = Engine(exp, params)
+        return (eng, eng.init_state())
+
+    measured = _measured_resident(build)
+    ratio = est.resident_bytes / measured
+    assert abs(ratio - 1.0) <= mem.AUDIT_TOLERANCE, (
+        est.resident_bytes, measured)
+
+
+def test_estimate_matches_live_bytes_fleet_e3():
+    from shadow1_tpu.fleet.engine import FleetEngine
+
+    exps = [phold_exp(seed=s) for s in (5, 6, 7)]
+    params = EngineParams(ev_cap=32, outbox_cap=16, metrics_ring=10)
+    est = mem.estimate(exps[0], params, n_exp=3)
+    assert est.planes["evbuf"] == 3 * mem.estimate(exps[0],
+                                                   params).planes["evbuf"]
+
+    def build():
+        eng = FleetEngine(exps, params)
+        return (eng, eng.init_state())
+
+    measured = _measured_resident(build)
+    ratio = est.resident_bytes / measured
+    assert abs(ratio - 1.0) <= mem.AUDIT_TOLERANCE, (
+        est.resident_bytes, measured)
+
+
+def test_estimate_matches_live_bytes_after_cap_resize():
+    """The post---auto-caps-resize audit: a state migrated to grown caps
+    (tune/resize.py — exactly what the controller and retry guard do)
+    matches the estimate at the NEW params."""
+    import jax
+    import numpy as np
+
+    from shadow1_tpu.tune.resize import resize_state
+
+    exp = phold_exp()
+    small = EngineParams(ev_cap=16, outbox_cap=16)
+    grown = dataclasses.replace(small, ev_cap=48)
+    eng = Engine(exp, small)
+    st = eng.run(n_windows=4)
+    host_st = jax.tree.map(np.asarray, st)
+    big = resize_state(host_st, ev_cap=48, outbox_cap=16)
+    measured_state = mem.tree_bytes(jax.tree_util.tree_leaves(big))
+    est = mem.estimate(exp, grown)
+    ratio = est.state_bytes / measured_state
+    assert abs(ratio - 1.0) <= mem.AUDIT_TOLERANCE, (
+        est.state_bytes, measured_state)
+
+
+def test_estimate_allocates_nothing_state_sized():
+    """The whole point of pre-flight: estimating a 1M-host config must not
+    allocate its planes (the abstract trace stages instead of executing)."""
+    exp = phold_exp(n_hosts=1 << 20)
+    params = EngineParams(ev_cap=256, outbox_cap=32)
+    gc.collect()
+    base = mem.live_bytes()
+    est = mem.estimate(exp, params)
+    assert est.state_bytes > (16 << 30)  # a >16 GiB config...
+    gc.collect()
+    grew = mem.live_bytes() - base
+    assert grew < (64 << 20), grew  # ...costs under 64 MiB to estimate
+
+
+# ---------------------------------------------------------------------------
+# Budget check + downshift planner
+# ---------------------------------------------------------------------------
+
+def test_check_budget_raises_structured_error():
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16, on_overflow="retry")
+    est = mem.estimate(exp, params)
+    with pytest.raises(mem.MemoryBudgetError) as ei:
+        mem.check_budget(est, est.peak_bytes // 2, "env")
+    e = ei.value
+    assert e.estimated == est.peak_bytes
+    assert e.planes["evbuf"] > 0 and e.peaks["rollback"] > 0
+    assert "--on-overflow halt" in e.advice  # rollback remedy named
+    assert "downshift" in e.advice
+    # over-budget is not OOM (handled by type, not string match)
+    assert not mem.is_oom(e)
+
+
+def test_downshift_order_rollback_then_ring_then_lanes():
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16, on_overflow="retry",
+                          metrics_ring=64)
+    est = mem.estimate(exp, params, n_exp=4)
+    # Budget that needs all three stages: below the rollback-dropped,
+    # ring-floored 4-lane peak but enough for 2 lanes.
+    no_roll = dataclasses.replace(params, on_overflow="halt",
+                                  metrics_ring=0)
+    floor4 = mem.estimate(exp, no_roll, n_exp=4)
+    floor2 = mem.estimate(exp, no_roll, n_exp=2)
+    budget = (floor2.peak_bytes + floor4.peak_bytes) // 2
+    p2, sub, actions = mem.downshift(exp, params, 4, budget)
+    kinds = [a["action"] for a in actions]
+    assert kinds == ["drop_rollback", "shrink_ring", "sub_batch"]
+    assert p2.on_overflow == "halt"
+    assert p2.metrics_ring < 64
+    assert 1 <= sub < 4
+    assert mem.estimate(exp, p2, n_exp=sub).peak_bytes <= budget
+
+
+def test_downshift_keeps_ring_when_digest_on():
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16, metrics_ring=64,
+                          state_digest=1)
+    tiny = mem.estimate(exp, dataclasses.replace(params, metrics_ring=1))
+    with pytest.raises(mem.MemoryBudgetError):
+        # even W=1 doesn't fit → exhausted, but never W=0 under digest
+        mem.downshift(exp, params, 1, tiny.peak_bytes // 4)
+    p2, _, actions = mem.downshift(exp, params, 1, tiny.peak_bytes + 64)
+    assert p2.metrics_ring == 1 and p2.state_digest == 1
+    assert actions[0]["action"] == "shrink_ring"
+
+
+def test_downshift_refuses_subbatch_with_ckpt():
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16)
+    e1 = mem.estimate(exp, params, n_exp=1)
+    e4 = mem.estimate(exp, params, n_exp=4)
+    budget = (e1.peak_bytes + e4.peak_bytes) // 2
+    with pytest.raises(mem.MemoryBudgetError) as ei:
+        mem.downshift(exp, params, 4, budget, resumable=True)
+    assert "--ckpt" in str(ei.value)
+
+
+def test_downshift_skips_ring_shrink_when_resumable():
+    """The ring is a state leaf: a resumable run must not shrink it (a
+    budget change against an existing lineage would hit a snapshot shape
+    mismatch) — only the shape-neutral rollback drop applies."""
+    exp = phold_exp()
+    params = EngineParams(ev_cap=32, outbox_cap=16, on_overflow="retry",
+                          metrics_ring=64)
+    no_roll = dataclasses.replace(params, on_overflow="halt")
+    floor = mem.estimate(exp, no_roll)
+    ringless = mem.estimate(
+        exp, dataclasses.replace(no_roll, metrics_ring=0))
+    budget = (ringless.peak_bytes + floor.peak_bytes) // 2
+    # non-resumable: rollback drop + ring shrink reach the budget
+    p2, _, actions = mem.downshift(exp, params, 1, budget)
+    assert [a["action"] for a in actions] == ["drop_rollback",
+                                              "shrink_ring"]
+    # resumable: the ring stage is skipped → downshift exhausts instead
+    with pytest.raises(mem.MemoryBudgetError) as ei:
+        mem.downshift(exp, params, 1, budget, resumable=True)
+    assert "snapshot shape" in str(ei.value)
+
+
+def test_is_oom_taxonomy():
+    assert mem.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                                   "allocating 123 bytes"))
+    assert mem.is_oom(MemoryError())
+    assert not mem.is_oom(RuntimeError("INVALID_ARGUMENT: shape"))
+    from shadow1_tpu.txn import CapacityExceededError
+
+    assert not mem.is_oom(CapacityExceededError(
+        "ev_cap", "ev_overflow", 8, 1, (0, 10)))
+
+
+def test_device_budget_env_override(monkeypatch):
+    monkeypatch.setenv(mem.MEM_BYTES_ENV, str(123 << 20))
+    b, src = mem.device_budget()
+    assert b == 123 << 20 and src == "env"
+
+
+# ---------------------------------------------------------------------------
+# Sub-batched fleet ≡ full fleet (the downshift bit-exactness contract)
+# ---------------------------------------------------------------------------
+
+def test_subbatched_fleet_digest_parity():
+    from shadow1_tpu.tools.memprobe import subbatch_parity
+
+    cfg = os.path.join(os.path.dirname(__file__), "..", "configs",
+                       "sweep_phold.yaml")
+    v = subbatch_parity(cfg, sub=3, windows=12, say=lambda m: None)
+    assert v["ok"], v
+    assert v["experiments"] == 4 and v["streams_compared"] == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI + supervisor (subprocess): EXIT_MEMORY taxonomy end to end
+# ---------------------------------------------------------------------------
+
+def _write_cfg(tmp_path, extra_engine="") -> str:
+    cfg = tmp_path / "mem_phold.yaml"
+    cfg.write_text(
+        "general: {seed: 5, stop_time: 20 ms}\n"
+        f"engine: {{scheduler: tpu, ev_cap: 32{extra_engine}}}\n"
+        "network: {single_vertex: {latency: 1 ms}}\n"
+        "hosts:\n"
+        "  - {name: h, count: 16}\n"
+        "app:\n"
+        "  model: phold\n"
+        "  params: {mean_delay_ns: 2000000.0, init_events: 4}\n"
+    )
+    return str(cfg)
+
+
+def test_cli_preflight_exit_memory(tmp_path):
+    """An over-budget config exits EXIT_MEMORY before compile with the
+    parseable record and per-plane advice (the capacity-halt shape)."""
+    cfg = _write_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           mem.MEM_BYTES_ENV: "30000"}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_MEMORY, (r.returncode, r.stderr[-600:])
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["error"] == "memory_budget"
+    assert rec["budget"] == 30000 and rec["estimated"] > 30000
+    assert rec["planes"]["evbuf"] > 0
+    assert "Remedies" in rec["advice"]
+    assert "MemoryBudgetError" in r.stderr
+
+
+def test_cli_emits_mem_record_and_runs_when_fits(tmp_path):
+    cfg = _write_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           mem.MEM_BYTES_ENV: str(1 << 30)}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg,
+                        "--windows", "5"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    mems = [json.loads(x) for x in r.stderr.splitlines()
+            if x.startswith("{") and '"type": "mem"' in x]
+    assert mems and mems[0]["event"] == "estimate"
+    assert mems[0]["budget"] == 1 << 30
+    assert mems[0]["headroom"] > 0
+    assert mems[0]["planes"]["evbuf"] > 0
+
+
+def test_cli_downshift_demotes_retry_and_runs(tmp_path):
+    """--on-oom downshift under a budget that fits only without the
+    rollback copy: retry demotes to halt, the run completes, and the
+    downshift record documents the action."""
+    cfg = _write_cfg(tmp_path)
+    from shadow1_tpu.config.experiment import load_experiment
+
+    exp, params, _ = load_experiment(cfg)
+    p = dataclasses.replace(params, on_overflow="retry", metrics_ring=10)
+    est = mem.estimate(exp, p)
+    budget = est.peak_bytes - est.peaks["rollback"] + 512
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           mem.MEM_BYTES_ENV: str(budget)}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg,
+                        "--windows", "10", "--on-overflow", "retry",
+                        "--metrics-ring", "10", "--on-oom", "downshift"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    ds = [json.loads(x) for x in r.stderr.splitlines()
+          if x.startswith("{") and '"event": "downshift"' in x]
+    assert ds and ds[0]["actions"][0]["action"] == "drop_rollback"
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["retries"]["policy"] == "halt"  # demoted, loud not lossy
+
+
+def test_cli_runtime_oom_maps_to_exit_memory(tmp_path):
+    """The runtime taxonomy: a RESOURCE_EXHAUSTED mid-run (injected) exits
+    EXIT_MEMORY with a phase-tagged parseable record."""
+    cfg = _write_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SHADOW1_MEM_INJECT_OOM": "run"}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg,
+                        "--windows", "5"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_MEMORY, (r.returncode, r.stderr[-600:])
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["error"] == "memory_exhausted"
+    assert rec["phase"] == "run"
+    assert "RESOURCE_EXHAUSTED" in rec["message"]
+
+
+def test_supervisor_classifies_exit_memory_no_respawn(tmp_path):
+    """--ckpt supervision over an over-budget child: EXIT_MEMORY is
+    deterministic — classify and stop, never respawn."""
+    cfg = _write_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SHADOW1_SUPERVISE_BACKOFF_S": "0",
+           mem.MEM_BYTES_ENV: "30000"}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg,
+                        "--ckpt", str(tmp_path / "ck.npz"),
+                        "--heartbeat", "5"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_MEMORY, (r.returncode, r.stderr[-600:])
+    assert "exhausted device memory (rc=EXIT_MEMORY)" in r.stderr
+    assert "respawning (" not in r.stderr  # zero respawn attempts
+
+
+def test_supervisor_classifies_raw_oom_crash(tmp_path):
+    """Belt and braces: a child that dies with a RAW RESOURCE_EXHAUSTED on
+    stderr (taxonomy bypassed — generic rc) is still classified via the
+    stderr scan; no crash-loop through the backoff ladder."""
+    cfg = _write_cfg(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SHADOW1_SUPERVISE_BACKOFF_S": "0",
+           "SHADOW1_MEM_INJECT_OOM": "raw"}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", cfg,
+                        "--ckpt", str(tmp_path / "ck.npz"),
+                        "--heartbeat", "5"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == EXIT_MEMORY, (r.returncode, r.stderr[-600:])
+    assert "raw RESOURCE_EXHAUSTED on stderr" in r.stderr
+    assert "respawning (" not in r.stderr
+    # the raw marker itself was teed through to the parent's stderr
+    assert "injected raw OOM" in r.stderr
+
+
+def test_cli_rejects_downshift_on_cpu_engine(tmp_path, capsys):
+    from shadow1_tpu.cli import main
+
+    cfg = _write_cfg(tmp_path)
+    with pytest.raises(SystemExit) as ei:
+        main([cfg, "--engine", "cpu", "--on-oom", "downshift"])
+    assert ei.value.code == 2
+    assert "batched engine" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Fleet CLI: sub-batched downshift end to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_fleet_subbatch_downshift(tmp_path):
+    cfg = tmp_path / "sweep.yaml"
+    cfg.write_text(
+        "general: {seed: 7, stop_time: 40 ms}\n"
+        "engine: {scheduler: tpu, ev_cap: 32, outbox_cap: 16}\n"
+        "network: {single_vertex: {latency: 10 ms}}\n"
+        "hosts:\n"
+        "  - {name: h, count: 8}\n"
+        "app:\n"
+        "  model: phold\n"
+        "  params: {mean_delay_ns: 2.0e7, init_events: 2}\n"
+        "sweep:\n"
+        "  seeds: [7, 8, 9, 10]\n"
+    )
+    from shadow1_tpu.fleet.expand import load_sweep
+
+    plan = load_sweep(str(cfg))
+    e2 = mem.estimate(plan.exps[0], plan.params, n_exp=2)
+    e4 = mem.estimate(plan.exps[0], plan.params, n_exp=4)
+    budget = (e2.peak_bytes + e4.peak_bytes) // 2
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           mem.MEM_BYTES_ENV: str(budget)}
+    r = subprocess.run([sys.executable, "-m", "shadow1_tpu", str(cfg),
+                        "--fleet", "--on-oom", "downshift"],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    recs = [json.loads(x) for x in r.stdout.strip().splitlines()]
+    exps = [x for x in recs if x.get("type") == "fleet_exp"]
+    summary = [x for x in recs if x.get("type") == "fleet_summary"][-1]
+    assert len(exps) == 4
+    assert sorted(x["exp"] for x in exps) == [0, 1, 2, 3]
+    assert summary["experiments"] == 4
+    assert summary["sub_batches"] >= 2
+    assert len(summary["events_per_exp"]) == 4
+    # sub-batched lanes must bit-match a full-fleet run of the same sweep
+    r2 = subprocess.run([sys.executable, "-m", "shadow1_tpu", str(cfg),
+                        "--fleet"],
+                        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                        capture_output=True, text=True, timeout=600)
+    assert r2.returncode == 0, r2.stderr[-800:]
+    full = {x["exp"]: x["metrics"]["events"]
+            for x in map(json.loads, r2.stdout.strip().splitlines())
+            if x.get("type") == "fleet_exp"}
+    assert {x["exp"]: x["metrics"]["events"] for x in exps} == full
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_report_memory_section(tmp_path, capsys):
+    from shadow1_tpu.tools.heartbeat_report import summarize
+
+    recs = [
+        {"type": "mem", "event": "estimate", "estimated_state": 1 << 20,
+         "estimated_resident": 1100000, "estimated_peak": 3 << 20,
+         "budget": 8 << 20, "budget_source": "env",
+         "headroom": (8 << 20) - (3 << 20),
+         "planes": {"evbuf": 600000, "model": 400000},
+         "peaks": {"output": 1 << 20, "rollback": 0, "transient": 100000}},
+        {"type": "mem", "event": "downshift", "budget": 8 << 20,
+         "estimated_peak": 2 << 20,
+         "actions": [{"action": "drop_rollback"}]},
+        {"type": "mem", "event": "final", "peak_in_use": 2500000,
+         "estimated_peak": 3 << 20},
+    ]
+    summary = summarize(recs)
+    out = capsys.readouterr().out
+    assert "== memory (estimate vs device) ==" in out
+    assert "reported peak in use" in out
+    assert "downshift applied: drop_rollback" in out
+    assert summary["memory"]["estimated_peak"] == 3 << 20
+    assert summary["memory"]["peak_in_use"] == 2500000
+    assert summary["memory"]["budget"] == 8 << 20
+    # mem fields never leak into ring percentile stats (their own type)
+    assert "ring" not in summary
+
+
+def test_memprobe_audit_exit_codes(tmp_path):
+    from shadow1_tpu.tools import memprobe
+
+    cfg = _write_cfg(tmp_path)
+    row = memprobe.audit_config(cfg)
+    assert row["ok"], row
+    assert abs(row["ratio"] - 1.0) <= mem.AUDIT_TOLERANCE

@@ -65,7 +65,10 @@ def _truncate(path):
 # ---------------------------------------------------------------------------
 
 def test_exit_code_taxonomy():
-    codes = (EXIT_OK, EXIT_CONFIG, EXIT_CAPACITY, EXIT_PREEMPTED, EXIT_HUNG)
+    from shadow1_tpu.consts import EXIT_MEMORY
+
+    codes = (EXIT_OK, EXIT_CONFIG, EXIT_CAPACITY, EXIT_PREEMPTED,
+             EXIT_HUNG, EXIT_MEMORY)
     assert len(set(codes)) == len(codes), "codes must be distinct"
     assert set(EXIT_CODES) == set(codes), "every code documented"
     # Codes must stay clear of shell/signal conventions: 1 is a generic
