@@ -15,7 +15,12 @@
 #                      an under-capped run under --on-overflow retry must
 #                      bit-match its big-cap twin's digest stream, and
 #                      --on-overflow halt must exit 4 with paste-ready
-#                      cap advice (CapacityExceededError)
+#                      cap advice (CapacityExceededError); plus the
+#                      perf-attribution smokes: the multi-row bench gate
+#                      (dense/sparse/fleet ms-per-round vs BENCH_GATE.json),
+#                      the opcensus eqn-drift gate (must trip on an
+#                      injected extra-op build) and a phaseprobe
+#                      attribution with >=90% coverage
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -26,7 +31,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_preempt.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_preempt.py tests/test_perfobs.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -232,19 +237,53 @@ assert sb["experiments"] == 4 and sb["streams_compared"] == 4, sb
 print("memprobe: 4-lane sweep sub-batched (3+1) bit-identical per lane,",
       sb["windows"], "windows")
 '
-    echo "== bench regression gate (BENCH_GATE.json, ms/round) =="
-    # ROADMAP item 5: nothing used to ENFORCE the perf trajectory. The
-    # gate fails on >5% ms/round regression vs the committed baseline;
-    # intentional trade-offs override once with
+    echo "== bench regression gate (BENCH_GATE.json, ms/round per row) =="
+    # ROADMAP item 5: the gate now carries THREE rows — dense smoke PHOLD,
+    # the sparse rung-1 TCP config and the 4-lane fleet sweep — each gated
+    # on >tolerance ms/round regression vs its committed per-backend
+    # baseline (a TPU baseline coexists with the CPU one; rows without a
+    # baseline for this backend report instead of auto-skipping the gate).
+    # Intentional trade-offs override once with
     # SHADOW1_BENCH_GATE_ACCEPT="why" and then re-baseline via --update.
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.benchgate \
         | python -c '
 import json, sys
 d = json.loads(sys.stdin.read().strip().splitlines()[-1])
-assert d["gate"] in ("ok", "accepted", "skipped_backend_mismatch",
-                     "skipped_host_mismatch", "no_baseline"), d
-print("benchgate:", d["gate"], "-", d["ms_per_round"], "ms/round vs",
-      d.get("baseline_ms_per_round"), "baseline")
+assert d["gate"] in ("ok", "no_baseline"), d
+for name, r in d.get("rows", {}).items():
+    assert r["gate"] in ("ok", "accepted", "no_baseline_for_backend",
+                         "skipped_host_mismatch"), (name, r["gate"])
+    print("benchgate:", name, r["gate"], "-", r.get("ms_per_round"),
+          "ms/round vs", r.get("baseline_ms_per_round"), "baseline")
+'
+    echo "== op/fusion census drift gate (OPCENSUS.json) =="
+    # Performance attribution plane: per-phase traced eqn counts must stay
+    # within tolerance of the committed baseline (the static early warning
+    # for ROADMAP item 1 kernel work) — and the gate must actually TRIP
+    # on an injected extra-op build.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.opcensus \
+        2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["gate"] in ("ok", "accepted"), d
+eq = {k: v["eqns"]["rounds"] for k, v in d["census"].items()}
+print("opcensus:", d["gate"], "- rounds-phase eqns", eq)
+'
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.opcensus \
+        smoke --inject 100 >/dev/null 2>&1 && rc=0 || rc=$?
+    [ "$rc" -eq 1 ] || { echo "opcensus: injected drift did not trip the gate (rc=$rc)" >&2; exit 1; }
+    echo "opcensus: injected 100-eqn drift tripped the gate (exit 1)"
+    echo "== phase attribution smoke (phaseprobe, >=90% coverage) =="
+    # The wall-clock half of the attribution plane: the phase split must
+    # account for >=90% of the straight run's measured ms/round.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.phaseprobe \
+        smoke --hosts 512 --windows 8 --warmup 4 --reps 2 \
+        --min-coverage 0.9 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["coverage"] >= 0.9, d
+print("phaseprobe: coverage", d["coverage"], "- rounds",
+      d["phases"]["rounds"]["pct"], "% of", d["ms_per_round"], "ms/round")
 '
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '

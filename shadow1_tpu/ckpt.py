@@ -52,7 +52,14 @@ import jax
 #      rather than a confusing leaf-shape one. Per-experiment resume
 #      slicing (fleet.engine.slice_experiment) re-saves one lane as a
 #      plain solo snapshot.
-CKPT_FORMAT = 9
+#  10: performance attribution plane — Metrics gains the wasted-work
+#      running sums active_hosts / elig_events / outbox_hosts, and any
+#      snapshot carrying a telemetry ring widens its row by the matching
+#      RING_WORK delta columns (telemetry/registry.py). Like the digest
+#      columns, no extra state rides the snapshot beyond the new leaves:
+#      the per-window values are pure boundary samples, so a resumed run's
+#      work-gauge stream continues bit-identically.
+CKPT_FORMAT = 10
 
 
 class CorruptCheckpointError(ValueError):

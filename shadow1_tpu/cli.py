@@ -1116,6 +1116,14 @@ def main(argv=None) -> int:
             # comparand for the batched engines' ring dg_* columns.
             for rec in eng.digest_rows:
                 print(json.dumps(rec), file=sys.stderr)
+        if eng.work_rows:
+            # The oracle's per-window wasted-work stream (REC_WORK rows) —
+            # the comparand for the batched engines' RING_WORK columns.
+            # Enabled by a config-level engine.metrics_ring (the --metrics-
+            # ring FLAG stays batched-only: there is no on-device ring
+            # here, only its per-window mirror).
+            for rec in eng.work_rows:
+                print(json.dumps(rec), file=sys.stderr)
     else:
         import jax
 
@@ -1349,6 +1357,18 @@ def main(argv=None) -> int:
 
     drops = {f: int(metrics.get(f, 0)) for f in DROP_FIELDS}
     out["drops"] = {"total": sum(drops.values()), **drops}
+    # Wasted-work accounting run totals (performance attribution plane):
+    # the per-window boundary samples summed over the run, with the
+    # denominators for utilization fractions — the heartbeat ``work`` block
+    # with run scope (tools/heartbeat_report.py reads n_hosts from here).
+    work = {f: int(metrics.get(f, 0))
+            for f in ("active_hosts", "elig_events", "outbox_hosts")}
+    n_win_total = int(metrics.get("windows", 0))
+    if any(work.values()):
+        out["work"] = {**work, "n_hosts": exp.n_hosts}
+        if n_win_total:
+            out["work"]["active_frac"] = round(
+                work["active_hosts"] / (n_win_total * exp.n_hosts), 6)
     # Fault plane run totals (schema mirrors the heartbeat ``faults`` block).
     restarts = int(metrics.get("host_restarts", 0))
     fault_drops = {k: drops[k] for k in

@@ -84,6 +84,19 @@ KIND_METRIC_FIELDS = {
     K_APP: ("pops_app", "fires_app"),
 }
 
+# Human-readable kind names — the phase attribution plane's handler-pass
+# labels (jax.named_scope spans in core/engine.run_round, the per-pass rows
+# of tools/opcensus.py and tools/phaseprobe.py).
+KIND_NAMES = {
+    K_NONE: "none",
+    K_PHOLD: "phold",
+    K_PKT: "pkt",
+    K_PKT_DELIVER: "deliver",
+    K_TCP_TIMER: "timer",
+    K_TX_RESUME: "txr",
+    K_APP: "app",
+}
+
 # Number of i32 payload columns on every event record.
 NP = 10
 

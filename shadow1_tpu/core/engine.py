@@ -31,6 +31,8 @@ from shadow1_tpu import rng
 from shadow1_tpu.config.compiled import CompiledExperiment
 from shadow1_tpu.consts import (
     KIND_METRIC_FIELDS,
+    KIND_NAMES,
+    K_NONE,
     R_JITTER,
     R_LOSS,
     EngineParams,
@@ -110,6 +112,19 @@ class Metrics(NamedTuple):
     # restarts (docs/SEMANTICS.md §"Fault plane").
     link_down_pkts: jnp.ndarray  # packets dropped: link outage window
     host_restarts: jnp.ndarray   # host restart resets applied (churn up)
+    # Wasted-work accounting (performance attribution plane): per-window
+    # boundary samples accumulated as running sums so the telemetry ring's
+    # delta columns recover the per-window values. All three sample
+    # engine-independent boundary quantities (the window-start pending set
+    # and the per-window send set are identical on every engine — the state
+    # digest's argument), so they are parity-exact cpu↔tpu↔sharded: each
+    # shard counts its local host block and the psum is the global value.
+    # active_hosts/n_hosts per window is exactly the ROADMAP's "rung-3/4
+    # rounds touch ~0.1% of hosts but pay full [cap, H] plane passes"
+    # pathology as a live signal.
+    active_hosts: jnp.ndarray    # Σ_w hosts with ≥1 eligible event at start
+    elig_events: jnp.ndarray     # Σ_w events eligible at window start
+    outbox_hosts: jnp.ndarray    # Σ_w hosts with ≥1 outbox slot used
 
 
 def _metrics_init() -> Metrics:
@@ -268,12 +283,14 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     the dead passes cuts the round cost correspondingly (handlers draw RNG
     and advance counters only where masked, so an all-false pass is a
     no-op by construction and skipping it is exact)."""
-    if ctx.params.pop_impl == "pallas":
-        from shadow1_tpu.core.popk import pop_until_fused
+    with jax.named_scope("phase:pop"):
+        if ctx.params.pop_impl == "pallas":
+            from shadow1_tpu.core.popk import pop_until_fused
 
-        evbuf, ev = pop_until_fused(st.evbuf, win_end)
-    else:
-        evbuf, ev = pop_until(st.evbuf, win_end, extract=ctx.params.pop_extract)
+            evbuf, ev = pop_until_fused(st.evbuf, win_end)
+        else:
+            evbuf, ev = pop_until(st.evbuf, win_end,
+                                  extract=ctx.params.pop_extract)
     st = st._replace(evbuf=evbuf)
     m = st.metrics
     n_down = jnp.zeros((), jnp.int64)
@@ -312,8 +329,10 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     )
     items = sorted(handlers.items())
     for kind, fn in items:
+        scope = f"phase:h_{KIND_NAMES.get(kind, kind)}"
         if len(items) == 1:
-            st = fn(st, ev)
+            with jax.named_scope(scope):
+                st = fn(st, ev)
         else:
             present = (ev.mask & (ev.kind == kind)).any()
             if kind in KIND_METRIC_FIELDS:
@@ -322,7 +341,8 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
                 st = st._replace(metrics=m2._replace(**{
                     fires: getattr(m2, fires) + present.astype(jnp.int64)
                 }))
-            st = jax.lax.cond(present, fn, lambda s, _e: s, st, ev)
+            with jax.named_scope(scope):
+                st = jax.lax.cond(present, fn, lambda s, _e: s, st, ev)
     return st
 
 
@@ -420,12 +440,20 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     the host axis when sharded — the one collective per window, SURVEY §2.5)."""
     from shadow1_tpu.core.outbox import outbox_fill
 
-    fp, n_sent, n_lost, n_linkdown = route_outbox(ctx, st.outbox)
-    ob_fill = outbox_fill(st.outbox)  # maintained [H] counter — before clear
+    with jax.named_scope("phase:route"):
+        fp, n_sent, n_lost, n_linkdown = route_outbox(ctx, st.outbox)
+    # Maintained [H] counters — read before the window-end clear. ob_hosts
+    # is the wasted-work gauge's numerator: hosts that actually used the
+    # [P, H] outbox planes this window (the oracle mirrors per-window send
+    # sets exactly, so this is parity-exact).
+    ob_fill = outbox_fill(st.outbox)
+    ob_hosts = (st.outbox.cnt > 0).sum(dtype=jnp.int64)
     n_x2x = x2x_hw = jnp.zeros((), jnp.int64)
     if exchange is not None:
-        fp, n_x2x, x2x_hw = exchange(fp)
-    evbuf, n_deliv, n_over, n_down = deliver_flat(st.evbuf, ctx, fp)
+        with jax.named_scope("phase:exchange"):
+            fp, n_x2x, x2x_hw = exchange(fp)
+    with jax.named_scope("phase:deliver"):
+        evbuf, n_deliv, n_over, n_down = deliver_flat(st.evbuf, ctx, fp)
     m = st.metrics
     return st._replace(
         evbuf=evbuf,
@@ -440,6 +468,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
             ob_max_fill=jnp.maximum(m.ob_max_fill, ob_fill),
             down_pkts=m.down_pkts + n_down,
             link_down_pkts=m.link_down_pkts + n_linkdown,
+            outbox_hosts=m.outbox_hosts + ob_hosts,
         ),
     )
 
@@ -463,6 +492,185 @@ def run_rounds(st: SimState, ctx: Ctx, handlers: dict, win_end):
     return st, (r >= max_rounds) & any_eligible(st.evbuf, win_end)
 
 
+class WindowFrame(NamedTuple):
+    """The intra-window carry threaded through the ``window_phases`` stages.
+
+    ``window_step`` is the composition of the stage list over this frame;
+    ``tools/phaseprobe.py`` jits the stages INDIVIDUALLY (on frames captured
+    from a real run) so wall time attributes per phase instead of per
+    window. Every leaf is a jittable array, so a frame crosses a jit
+    boundary unchanged."""
+
+    st: SimState
+    m_entry: Metrics        # metrics at window entry (ring delta baseline)
+    win_end: jnp.ndarray    # i64 scalar
+    cap_hit: jnp.ndarray    # bool scalar (set by the rounds phase)
+    dg_ob: jnp.ndarray      # i64 outbox digest word (digest runs only)
+
+
+def window_frame(st: SimState, ctx: Ctx) -> WindowFrame:
+    """The entry frame of one conservative window."""
+    return WindowFrame(
+        st=st,
+        m_entry=st.metrics,
+        win_end=st.win_start + ctx.window,
+        cap_hit=jnp.zeros((), bool),
+        dg_ob=jnp.zeros((), jnp.int64),
+    )
+
+
+def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
+                  make_handlers=None, telem_reduce=None):
+    """The ordered (name, frame → frame) stage list of one window.
+
+    The phase decomposition of the jitted ``window_step`` (performance
+    attribution plane): ``prepare`` (restart resets, work gauges, the net
+    model's NIC arrival batch, rebase/clear), ``rounds`` (the pop + handler
+    while-loop — sub-annotated ``phase:pop`` / ``phase:h_<kind>`` in
+    run_round), ``deliver`` (route + optional exchange collective + the
+    destination scatter + outbox clear — sub-annotated in deliver_window),
+    ``telem`` (occupancy gauges, window counters, the telemetry-ring row).
+    Each stage is wrapped in ``jax.named_scope("phase:<name>")`` by
+    window_step, so device traces (``jax.profiler`` via
+    telemetry/profiler.device_trace) carry the phases as spans, and
+    ``tools/opcensus.py`` censuses each stage's jaxpr separately."""
+    from shadow1_tpu.core.events import push_impl_ctx, rebase
+
+    digest_on = bool(ctx.params.state_digest)
+
+    def ph_prepare(fr: WindowFrame) -> WindowFrame:
+        st, win_end = fr.st, fr.win_end
+        if ctx.has_restart:
+            # Host restart (fault plane): hosts whose window-quantized up
+            # time IS this window's start get their model columns (tcp
+            # socks, nic clocks/counters, app state) restored to the
+            # post-init capture and their virtual-CPU clock zeroed — BEFORE
+            # this window's rounds, so events timed at/after the restart
+            # execute against fresh state. The event buffer is deliberately
+            # untouched: stale events are a pure function of time
+            # (dead-interval ones discard at pop), so the oracle's eager
+            # heap and this batched reset stay bit-equal.
+            from shadow1_tpu.fault.plane import (
+                reset_host_columns,
+                restart_mask,
+            )
+
+            rs = restart_mask(ctx.fault_up, st.win_start)
+            mr = st.metrics
+            st = st._replace(
+                model=reset_host_columns(st.model, ctx.init_model, rs,
+                                         ctx.n_hosts),
+                cpu_busy=jnp.where(rs, 0, st.cpu_busy),
+                metrics=mr._replace(
+                    host_restarts=mr.host_restarts + rs.sum(dtype=jnp.int64)),
+            )
+        n_act = n_el = None
+        if pre_window is not None:
+            # Wasted-work gauges BEFORE the NIC arrival batch rewrites
+            # event times: the RAW window-start pending set (events with
+            # time < win_end) is the engine-independent quantity the CPU
+            # oracle mirrors from its heap at the same boundary — the
+            # post-conversion eligibility (queue-cleared times) is not.
+            abs_t = st.evbuf.abs_time()
+            live = (st.evbuf.kind != K_NONE) & (abs_t < win_end)
+            n_act = live.any(axis=0).sum(dtype=jnp.int64)
+            n_el = live.sum(dtype=jnp.int64)
+            st = pre_window(st, ctx, win_end)
+        # Advance the i32 pop-key epoch to this window's start
+        # (core/events.py: the round loop runs i64-free; pre_window and
+        # last window's delivery write absolute times only, repaired here).
+        st = st._replace(evbuf=rebase(st.evbuf, st.win_start, win_end))
+        # Compaction-bucket demand gauge: this window's active-host count
+        # (the lanes compact_cap must cover), read off the just-rebased [H]
+        # eligibility counters — recorded whether or not compaction is on,
+        # so the knob can be sized BEFORE enabling it, and the compacted
+        # and plain engines stay bit-identical (tests/test_compact.py).
+        # Local-block count under sharding (the per-shard bucket is the
+        # resource), like rounds.
+        n_active = (st.evbuf.n_elig > 0).sum(dtype=jnp.int64)
+        if n_act is None:
+            # No pre-window time rewrite: the just-rebased eligibility
+            # counters ARE the raw window-start set — the work gauges cost
+            # zero extra plane passes on this path.
+            n_act = n_active
+            n_el = st.evbuf.n_elig.sum(dtype=jnp.int64)
+        m0 = st.metrics
+        st = st._replace(metrics=m0._replace(
+            compact_max_fill=jnp.maximum(m0.compact_max_fill, n_active),
+            active_hosts=m0.active_hosts + n_act,
+            elig_events=m0.elig_events + n_el,
+        ))
+        return fr._replace(st=st)
+
+    def ph_rounds(fr: WindowFrame) -> WindowFrame:
+        st = fr.st
+        ccap = ctx.params.compact_cap
+        # push_impl scopes over the round tracing: every handler-layer
+        # push_local/push_back below dispatches to the selected
+        # implementation (trace-time — see events.push_impl_ctx).
+        with push_impl_ctx(ctx.params.push_impl):
+            if ccap and ccap < ctx.n_hosts and make_handlers is not None:
+                from shadow1_tpu.core.compact import compact_window_rounds
+
+                st, cap_hit = compact_window_rounds(
+                    st, ctx, handlers, make_handlers, run_rounds,
+                    fr.win_end, ccap
+                )
+            else:
+                st, cap_hit = run_rounds(st, ctx, handlers, fr.win_end)
+        return fr._replace(st=st, cap_hit=cap_hit)
+
+    def ph_deliver(fr: WindowFrame) -> WindowFrame:
+        st, dg_ob = fr.st, fr.dg_ob
+        if digest_on and st.telem is not None:
+            # The outbox still holds this window's sends here — the
+            # delivery below routes and clears it, so its digest word is
+            # taken first.
+            from shadow1_tpu.core.digest import digest_outbox
+
+            dg_ob = digest_outbox(st.outbox, ctx.hosts)
+        st = deliver_window(st, ctx, exchange)
+        return fr._replace(st=st, dg_ob=dg_ob)
+
+    def ph_telem(fr: WindowFrame) -> WindowFrame:
+        # Window-end event-slot occupancy: computed ONCE here (one [C, H]
+        # pass per window, off the round path) and shared by the run-max
+        # gauge and the telemetry ring's per-window column.
+        from shadow1_tpu.core.events import evbuf_fill
+
+        st = fr.st
+        ev_fill = evbuf_fill(st.evbuf)
+        m = st.metrics
+        st = st._replace(
+            win_start=fr.win_end,
+            metrics=m._replace(
+                windows=m.windows + 1,
+                round_cap_hits=m.round_cap_hits
+                + fr.cap_hit.astype(jnp.int64),
+                ev_max_fill=jnp.maximum(m.ev_max_fill, ev_fill),
+            ),
+        )
+        if st.telem is not None:
+            from shadow1_tpu.telemetry.ring import ring_record
+
+            digests = None
+            if digest_on:
+                # Everything but the outbox digests the post-delivery
+                # window-boundary state — exactly the pending/live sets the
+                # CPU oracle sees when its next event crosses this boundary.
+                from shadow1_tpu.core.digest import state_digests
+
+                digests = state_digests(st, ctx, fr.dg_ob)
+            st = st._replace(telem=ring_record(
+                st.telem, fr.m_entry, st.metrics, ev_fill, telem_reduce,
+                digests=digests,
+            ))
+        return fr._replace(st=st)
+
+    return [("prepare", ph_prepare), ("rounds", ph_rounds),
+            ("deliver", ph_deliver), ("telem", ph_telem)]
+
+
 def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
                 pre_window=None, make_handlers=None,
                 telem_reduce=None) -> SimState:
@@ -483,102 +691,19 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
 
     When the state carries a telemetry ring (``st.telem``), the window's
     metric deltas are recorded into it here, still inside the trace —
-    ``telem_reduce`` globalizes the row under sharding (telemetry/ring.py)."""
-    from shadow1_tpu.core.events import push_impl_ctx, rebase
+    ``telem_reduce`` globalizes the row under sharding (telemetry/ring.py).
 
-    metrics_at_entry = st.metrics  # per-window delta baseline (ring)
-    # Determinism flight recorder (core/digest.py): traced only when the
-    # knob is on AND a ring exists to carry the words — state_digest=0
-    # (default) adds zero ops here and zero ops anywhere else.
-    digest_on = bool(ctx.params.state_digest) and st.telem is not None
-    if ctx.has_restart:
-        # Host restart (fault plane): hosts whose window-quantized up time
-        # IS this window's start get their model columns (tcp socks, nic
-        # clocks/counters, app state) restored to the post-init capture and
-        # their virtual-CPU clock zeroed — BEFORE this window's rounds, so
-        # events timed at/after the restart execute against fresh state.
-        # The event buffer is deliberately untouched: stale events are a
-        # pure function of time (dead-interval ones discard at pop), so
-        # the oracle's eager heap and this batched reset stay bit-equal.
-        from shadow1_tpu.fault.plane import reset_host_columns, restart_mask
-
-        rs = restart_mask(ctx.fault_up, st.win_start)
-        mr = st.metrics
-        st = st._replace(
-            model=reset_host_columns(st.model, ctx.init_model, rs,
-                                     ctx.n_hosts),
-            cpu_busy=jnp.where(rs, 0, st.cpu_busy),
-            metrics=mr._replace(
-                host_restarts=mr.host_restarts + rs.sum(dtype=jnp.int64)),
-        )
-    win_end = st.win_start + ctx.window
-    if pre_window is not None:
-        st = pre_window(st, ctx, win_end)
-    # Advance the i32 pop-key epoch to this window's start (core/events.py:
-    # the round loop below runs i64-free; pre_window and last window's
-    # delivery write absolute times only, repaired here).
-    st = st._replace(evbuf=rebase(st.evbuf, st.win_start, win_end))
-    # Compaction-bucket demand gauge: this window's active-host count (the
-    # lanes compact_cap must cover), read off the just-rebased [H]
-    # eligibility counters — recorded whether or not compaction is on, so
-    # the knob can be sized BEFORE enabling it, and the compacted and plain
-    # engines stay bit-identical (tests/test_compact.py). Local-block count
-    # under sharding (the per-shard bucket is the resource), like rounds.
-    n_active = (st.evbuf.n_elig > 0).sum(dtype=jnp.int64)
-    m0 = st.metrics
-    st = st._replace(metrics=m0._replace(
-        compact_max_fill=jnp.maximum(m0.compact_max_fill, n_active)))
-    ccap = ctx.params.compact_cap
-    # push_impl scopes over the round tracing: every handler-layer
-    # push_local/push_back below dispatches to the selected implementation
-    # (trace-time — see events.push_impl_ctx).
-    with push_impl_ctx(ctx.params.push_impl):
-        if ccap and ccap < ctx.n_hosts and make_handlers is not None:
-            from shadow1_tpu.core.compact import compact_window_rounds
-
-            st, cap_hit = compact_window_rounds(
-                st, ctx, handlers, make_handlers, run_rounds, win_end, ccap
-            )
-        else:
-            st, cap_hit = run_rounds(st, ctx, handlers, win_end)
-    if digest_on:
-        # The outbox still holds this window's sends here — the delivery
-        # below routes and clears it, so its digest word is taken first.
-        from shadow1_tpu.core.digest import digest_outbox
-
-        dg_ob = digest_outbox(st.outbox, ctx.hosts)
-    st = deliver_window(st, ctx, exchange)
-    # Window-end event-slot occupancy: computed ONCE here (one [C, H] pass
-    # per window, off the round path) and shared by the run-max gauge and
-    # the telemetry ring's per-window column.
-    from shadow1_tpu.core.events import evbuf_fill
-
-    ev_fill = evbuf_fill(st.evbuf)
-    m = st.metrics
-    st = st._replace(
-        win_start=win_end,
-        metrics=m._replace(
-            windows=m.windows + 1,
-            round_cap_hits=m.round_cap_hits + cap_hit.astype(jnp.int64),
-            ev_max_fill=jnp.maximum(m.ev_max_fill, ev_fill),
-        ),
-    )
-    if st.telem is not None:
-        from shadow1_tpu.telemetry.ring import ring_record
-
-        digests = None
-        if digest_on:
-            # Everything but the outbox digests the post-delivery window-
-            # boundary state — exactly the pending/live sets the CPU oracle
-            # sees when its next event crosses this boundary.
-            from shadow1_tpu.core.digest import state_digests
-
-            digests = state_digests(st, ctx, dg_ob)
-        st = st._replace(telem=ring_record(
-            st.telem, metrics_at_entry, st.metrics, ev_fill, telem_reduce,
-            digests=digests,
-        ))
-    return st
+    Structured as the composition of the ``window_phases`` stage list, each
+    under a ``jax.named_scope("phase:<name>")`` — the performance
+    attribution plane's decomposition (tools/phaseprobe.py times the stages
+    individually; tools/opcensus.py censuses their jaxprs; device traces
+    carry them as spans)."""
+    fr = window_frame(st, ctx)
+    for name, fn in window_phases(ctx, handlers, exchange, pre_window,
+                                  make_handlers, telem_reduce):
+        with jax.named_scope(f"phase:{name}"):
+            fr = fn(fr)
+    return fr.st
 
 
 _QLEN_INF = 1 << 62

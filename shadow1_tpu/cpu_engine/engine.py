@@ -112,6 +112,15 @@ class CpuEngine:
             # gauges bit-exactly on overflow-free runs.
             "ev_max_fill": 0,
             "ob_max_fill": 0,
+            # Wasted-work accounting (performance attribution plane):
+            # running sums of the per-window boundary samples, mirroring
+            # core/engine.window_phases ph_prepare/deliver_window. Same
+            # engine-independence argument as the fill gauges: the
+            # window-start pending set and the per-window send set are
+            # identical across engines on overflow-free runs.
+            "active_hosts": 0,
+            "elig_events": 0,
+            "outbox_hosts": 0,
         }
         self._next_boundary = self.window  # first window-end sample point
         # Per-kind pop occupancy fields (shared table — consts).
@@ -139,6 +148,19 @@ class CpuEngine:
         self._ev_word: dict[int, int] = {}  # gseq → element word
         self._ob_dg: dict[int, int] = {}    # window → send-word sum
         self.digest_rows: list[dict] = []
+        # Wasted-work accounting (performance attribution plane): per-window
+        # boundary samples, mirroring the batched engines' ring columns
+        # (telemetry/registry.RING_WORK). Gated on metrics_ring like the
+        # digest rows — the per-boundary heap scan is pay-for-use, and the
+        # ring is where the batched engines carry the per-window values.
+        # Rows land in ``work_rows`` as JSONL-ready REC_WORK dicts; the
+        # cumulative metrics counters advance in lockstep so final counters
+        # compare bit-exactly against the batched engines.
+        self.work_on = self.params.metrics_ring > 0
+        self.work_rows: list[dict] = []
+        self._work_pending: dict[int, dict] = {}  # window → open row
+        self._ob_hosts: dict[int, int] = {}       # window → distinct senders
+        self._work_next_open = 0                  # next window to sample
         self.model = self._make_model()
         self.model.start()
         # Seed-time overflow is baselined out, mirroring the batch guard's
@@ -239,6 +261,12 @@ class CpuEngine:
         self._ob_used[src] += 1
         if int(self._ob_used[src]) > self.metrics["ob_max_fill"]:
             self.metrics["ob_max_fill"] = int(self._ob_used[src])
+        if self.work_on and int(self._ob_used[src]) == 1:
+            # First outbox slot this host touched this window — the
+            # outbox_hosts gauge's element (deliver_window counts cnt > 0
+            # at window end; the sets are identical).
+            w = now // self.window
+            self._ob_hosts[w] = self._ob_hosts.get(w, 0) + 1
         ctr = int(self.pkt_ctr[src])
         self.pkt_ctr[src] += 1
         if self.digest_on:
@@ -328,36 +356,91 @@ class CpuEngine:
         fill = int(self.pending.max()) if self.pending.size else 0
         if fill > self.metrics["ev_max_fill"]:
             self.metrics["ev_max_fill"] = fill
-        if not self.digest_on:
+        if not self.digest_on and not self.work_on:
             n_skipped = (upto - self._next_boundary) // self.window + 1
             self._next_boundary += n_skipped * self.window
             self._apply_restarts_pending(upto)
             self._boundary_checks(first_w)
             return
-        # One row per boundary window. The plane digests are static across
-        # a multi-boundary stretch (no event ran in between, and no restart
+        # One pass per boundary. The plane digests are static across a
+        # multi-boundary stretch (no event ran in between, and no restart
         # fired — a restart invalidates the cache) — computed once; only
         # the per-window outbox sums differ (0 for idle windows, matching
-        # the TPU's empty-outbox digest).
-        from shadow1_tpu.telemetry.registry import REC_DIGEST
+        # the TPU's empty-outbox digest). The work-gauge samples, by
+        # contrast, move per boundary (the eligibility bound advances one
+        # window each time), so they are recomputed per window from the
+        # static heap.
+        if self.digest_on:
+            from shadow1_tpu.telemetry.registry import REC_DIGEST
 
-        dg_tcp, dg_nic, dg_rng = self._digest_planes()
+            dg_tcp, dg_nic, dg_rng = self._digest_planes()
         while self._next_boundary <= upto:
             b = self._next_boundary
             w = b // self.window - 1
-            self.digest_rows.append({
-                "type": REC_DIGEST,
-                "window": w,
-                "dg_evbuf": self._ev_dg,
-                "dg_outbox": self._ob_dg.pop(w, 0),
-                "dg_tcp": dg_tcp,
-                "dg_nic": dg_nic,
-                "dg_rng": dg_rng,
-            })
+            if self.digest_on:
+                self.digest_rows.append({
+                    "type": REC_DIGEST,
+                    "window": w,
+                    "dg_evbuf": self._ev_dg,
+                    "dg_outbox": self._ob_dg.pop(w, 0),
+                    "dg_tcp": dg_tcp,
+                    "dg_nic": dg_nic,
+                    "dg_rng": dg_rng,
+                })
+            if self.work_on:
+                self._work_close(w)
             self._next_boundary += self.window
-            if self._apply_restarts_pending(b):
+            if self._apply_restarts_pending(b) and self.digest_on:
                 dg_tcp, dg_nic, dg_rng = self._digest_planes()
+            if self.work_on:
+                self._work_catchup()
         self._boundary_checks(first_w)
+
+    # -- wasted-work accounting (performance attribution plane) -----------
+    def _work_catchup(self) -> None:
+        """Open the window-start work sample of every window whose start
+        boundary has been crossed (all earlier events executed — the heap
+        IS the engine-independent boundary pending set) and that this run
+        will actually execute (start < run end). Monotonic, so incremental
+        run() continuations (paritytrace lockstep chunks) sample each
+        window exactly once, including window 0 on the first call."""
+        while (self._work_next_open * self.window < self._cur_end
+               and self._work_next_open * self.window < self._next_boundary):
+            self._work_open(self._work_next_open * self.window)
+            self._work_next_open += 1
+
+    def _work_open(self, b: int) -> None:
+        """Window-start sample for the window beginning at sim time ``b``:
+        active hosts (≥1 pending event with time < b+W) and eligible
+        events — exactly the raw window-start set core/engine.window_phases
+        ph_prepare gauges before the NIC arrival batch rewrites times."""
+        from shadow1_tpu.telemetry.registry import REC_WORK
+
+        bound = b + self.window
+        hosts = set()
+        n_el = 0
+        for ent in self.heap:
+            if ent[0] < bound:
+                n_el += 1
+                hosts.add(ent[3])
+        self.metrics["active_hosts"] += len(hosts)
+        self.metrics["elig_events"] += n_el
+        self._work_pending[b // self.window] = {
+            "type": REC_WORK, "window": b // self.window,
+            "active_hosts": len(hosts), "elig_events": n_el,
+        }
+
+    def _work_close(self, w: int) -> None:
+        """Window-end half of the sample: the distinct-sender count the
+        batched engine reads off the outbox ``cnt`` plane before its
+        window-end clear."""
+        row = self._work_pending.pop(w, None)
+        if row is None:
+            return
+        n = self._ob_hosts.pop(w, 0)
+        self.metrics["outbox_hosts"] += n
+        row["outbox_hosts"] = n
+        self.work_rows.append(row)
 
     def _boundary_checks(self, w: int) -> None:
         """The chunk-boundary guard's window-granularity twin (txn.py):
@@ -420,6 +503,8 @@ class CpuEngine:
         # actually runs (win_start < end); a boundary AT the run end defers
         # to a later run() continuation (paritytrace's lockstep chunks).
         self._cur_end = max(self._cur_end, end)
+        if self.work_on:
+            self._work_catchup()
         rx_batch = getattr(self.model, "rx_batch", False)
         while self.heap and self.heap[0][0] < end:
             self._sample_fill(int(self.heap[0][0]))

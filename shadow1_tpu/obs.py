@@ -130,6 +130,24 @@ class Heartbeat:
                        if k in drops}
         if restarts or any(fault_drops.values()):
             rec["faults"] = {"host_restarts": restarts, **fault_drops}
+        # Wasted-work accounting (performance attribution plane): the three
+        # per-window boundary samples summed over this chunk, with the
+        # denominators a consumer needs to turn them into utilization
+        # fractions (n_hosts, the chunk's window count). Running sums, not
+        # rates — they leave ``delta`` like the fill gauges and ride a
+        # ``work`` block; tools/heartbeat_report.py's work-efficiency
+        # section consumes it (and reads n_hosts from here for the
+        # per-window ring fractions).
+        work = {f: delta.pop(f, 0) for f in
+                ("active_hosts", "elig_events", "outbox_hosts")}
+        n_hosts = getattr(getattr(self.engine, "exp", None), "n_hosts", None)
+        if any(work.values()):
+            rec["work"] = dict(work)
+            if n_hosts:
+                rec["work"]["n_hosts"] = n_hosts
+                if d_windows:
+                    rec["work"]["active_frac"] = round(
+                        work["active_hosts"] / (d_windows * n_hosts), 6)
         # Capacity occupancy: run-max fill gauges against their caps — the
         # data the cap controller and tools/captune.py size caps from.
         # High-water marks, not rates: they leave ``delta`` and ride a

@@ -243,6 +243,17 @@ def _state_in(state, states):
 
 def tcp_flush(st, ctx, mask, sock, now):
     """Send as many pending segments of ``sock`` as burst/window/outbox
+    allow; schedule K_TX_RESUME to continue if still pending — annotated
+    ``phase:tcp_flush`` for the performance attribution plane (the flush
+    machine is the single largest source in the deliver-pass op census,
+    docs/PERF.md; the scope makes it visible in device traces and in
+    tools/opcensus.py's per-source table)."""
+    with jax.named_scope("phase:tcp_flush"):
+        return _tcp_flush(st, ctx, mask, sock, now)
+
+
+def _tcp_flush(st, ctx, mask, sock, now):
+    """Send as many pending segments of ``sock`` as burst/window/outbox
     allow; schedule K_TX_RESUME to continue if still pending.
 
     Bit-exact vectorization of the former per-segment loop (round-4 op-count

@@ -18,10 +18,12 @@ it lazily from host-only paths.
 from shadow1_tpu.telemetry.profiler import (  # noqa: F401
     PH_CHECKPOINT,
     PH_COMPILE,
+    PH_DEVICE_TRACE,
     PH_DRAIN,
     PH_INIT,
     PH_RUN_CHUNK,
     PhaseProfiler,
+    device_trace,
     maybe_span,
 )
 from shadow1_tpu.telemetry.registry import (  # noqa: F401
@@ -33,6 +35,7 @@ from shadow1_tpu.telemetry.registry import (  # noqa: F401
     RING_DIGESTS,
     RING_FIELDS,
     RING_GAUGES,
+    RING_WORK,
     ExpositionServer,
     normalize,
     to_prometheus,

@@ -29,6 +29,8 @@ PH_INIT = "init"
 PH_RUN_CHUNK = "run-chunk"
 PH_DRAIN = "drain"
 PH_CHECKPOINT = "checkpoint"
+# Device-trace span (the jax.profiler capture window — see device_trace).
+PH_DEVICE_TRACE = "device-trace"
 
 
 class PhaseProfiler:
@@ -109,3 +111,45 @@ def maybe_span(profiler: PhaseProfiler | None, name: str, **args):
     if profiler is None:
         return contextlib.nullcontext()
     return profiler.span(name, **args)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, profiler: PhaseProfiler | None = None,
+                 perfetto: bool = True):
+    """The op-level zoom under the host-side phase spans: a ``jax.profiler``
+    device trace scoped over the with-body, written to ``log_dir``.
+
+    The engine's window program is annotated with
+    ``jax.named_scope("phase:...")`` spans (core/engine.window_phases:
+    prepare / rounds (pop, h_<kind>) / route / exchange / deliver / telem
+    — plus ``phase:tcp_flush`` inside the TCP send path), so the captured
+    trace shows exactly which window phase each device op belongs to.
+    With ``perfetto=True`` jax also writes a ``*.perfetto-trace`` file
+    under ``log_dir/plugins/profile/<run>/`` that https://ui.perfetto.dev
+    loads directly (the TensorBoard profile plugin reads the same
+    directory). A ``device-trace`` host span marks the capture window in
+    the PhaseProfiler's own Chrome trace so the two zoom levels line up.
+
+    Degrades gracefully: if the installed jax cannot start a profiler
+    session (no profiler support, or a session already active), the body
+    still runs and a warning names the reason — attribution tools must
+    never fail a run over a missing trace backend."""
+    import jax
+
+    started = False
+    try:
+        try:
+            jax.profiler.start_trace(log_dir,
+                                     create_perfetto_trace=perfetto)
+            started = True
+        except Exception as e:  # profiler backend unavailable — not fatal
+            import warnings
+
+            warnings.warn(f"jax device trace unavailable ({e}); phases "
+                          "still carry jax.named_scope annotations but no "
+                          "device trace was captured")
+        with maybe_span(profiler, PH_DEVICE_TRACE, log_dir=log_dir):
+            yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()

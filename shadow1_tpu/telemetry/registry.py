@@ -69,6 +69,22 @@ METRIC_SPECS: dict[str, tuple[str, str]] = {
     "fires_app": (COUNTER, "rounds where the K_APP pass fired"),
     "link_down_pkts": (COUNTER, "packets dropped: link outage window (fault plane)"),
     "host_restarts": (COUNTER, "host restart resets applied (fault plane churn)"),
+    # Wasted-work accounting (performance attribution plane): per-window
+    # boundary samples accumulated as running sums, so the per-window value
+    # rides the telemetry ring as a delta like any counter. All three are
+    # engine-independent boundary quantities (the window-start pending set
+    # and the per-window send set are the same on every engine — the digest
+    # contract's argument), so they are bit-exact cpu<->tpu<->sharded.
+    "active_hosts": (COUNTER, "sum over windows of hosts with >=1 eligible "
+                              "event at window start (vs n_hosts: the "
+                              "fraction of the [cap, H] plane passes doing "
+                              "real work)"),
+    "elig_events": (COUNTER, "sum over windows of events eligible at window "
+                             "start (the work actually available to the "
+                             "round loop)"),
+    "outbox_hosts": (COUNTER, "sum over windows of hosts with >=1 outbox "
+                              "slot used (vs n_hosts: the live fraction of "
+                              "the route/deliver pass)"),
     "chunk_retries": (COUNTER, "chunks discarded and replayed after overflow "
                                "(--on-overflow retry; txn.OverflowGuard)"),
     "retry_windows_rerun": (COUNTER, "windows re-executed by overflow "
@@ -109,9 +125,16 @@ REC_LINEAGE = "lineage"
 # enter ring percentile math: they are their own record type, summarized by
 # tools/heartbeat_report.py's "memory" section.
 REC_MEM = "mem"
+# Performance attribution plane: ``work`` is the CPU oracle's per-window
+# wasted-work row (the batched engines carry the same values as the
+# RING_WORK ring columns instead — one schema, two carriers, exactly like
+# the digest words). Fields: window, active_hosts, elig_events,
+# outbox_hosts. Summarized by tools/heartbeat_report.py's work-efficiency
+# section; never enters ring percentile math.
+REC_WORK = "work"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
-                REC_RESUME, REC_LINEAGE, REC_MEM)
+                REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK)
 
 # The drop/overflow counter group: every way a modeled event or packet can
 # be discarded, with the human-readable reason. Heartbeat records and the
@@ -146,6 +169,20 @@ RING_COUNTERS = (
     "ev_overflow", "ob_overflow", "x2x_overflow", "down_events", "down_pkts",
     "link_down_pkts", "host_restarts",
 )
+# Wasted-work accounting columns (performance attribution plane): per-window
+# DELTAS of the matching METRIC_SPECS counters, i.e. the window's boundary
+# sample itself (the counters are running sums of per-window samples).
+# Additive across shards like the counter deltas (each shard counts its host
+# block; the psum is the global value, bit-equal to single-device), and
+# mirrored bit-exactly by the CPU oracle's boundary sampling (work_rows).
+# Kept OUT of RING_COUNTERS so ring percentile consumers that rank raw
+# counter deltas don't blend utilization samples in — the work-efficiency
+# section (tools/heartbeat_report.py) owns their presentation.
+RING_WORK = (
+    "active_hosts",   # hosts with >=1 eligible event at window start
+    "elig_events",    # events eligible at window start
+    "outbox_hosts",   # hosts that used >=1 outbox slot this window
+)
 RING_GAUGES = (
     "evbuf_fill",       # max pending events on any host at window end
     "ev_max_fill",      # running high-water of evbuf_fill (vs ev_cap)
@@ -165,7 +202,7 @@ RING_DIGESTS = (
     "dg_rng",     # per-host deterministic counters (self_ctr/pkt_ctr/cpu_busy
                   # + model draw counters)
 )
-RING_FIELDS = RING_COUNTERS + RING_GAUGES + RING_DIGESTS
+RING_FIELDS = RING_COUNTERS + RING_WORK + RING_GAUGES + RING_DIGESTS
 
 
 def counter_names() -> tuple[str, ...]:

@@ -76,11 +76,14 @@ def ring_record(ring: TelemetryRing, m0, m1, ev_fill,
     PARTIAL SUMS, so they ride the psum'd counter vector and come out as
     the exact single-device digests. ``x2x_max_fill`` is already replicated
     by the exchange's psum trick, so it bypasses the reduce."""
-    from shadow1_tpu.telemetry.registry import RING_DIGESTS
+    from shadow1_tpu.telemetry.registry import RING_DIGESTS, RING_WORK
 
     w = ring.buf.shape[0]
+    # The wasted-work columns (RING_WORK) are deltas of running-sum
+    # counters like the rest — additive across shards, so they ride the
+    # same psum'd vector and come out globally exact.
     counters = jnp.stack(
-        [getattr(m1, f) - getattr(m0, f) for f in RING_COUNTERS]
+        [getattr(m1, f) - getattr(m0, f) for f in RING_COUNTERS + RING_WORK]
     )
     if digests is None:
         digests = jnp.zeros(len(RING_DIGESTS), jnp.int64)

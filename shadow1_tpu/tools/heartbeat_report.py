@@ -29,6 +29,7 @@ from shadow1_tpu.telemetry.registry import (
     REC_RING,
     REC_RING_GAP,
     REC_TRACKER,
+    REC_WORK,
     RING_COUNTERS,
     RING_FIELDS,
     RING_GAUGES,
@@ -62,6 +63,88 @@ def percentile(values: list, q: float):
     return s[min(len(s) - 1, max(0, idx))]
 
 
+def work_summary(rows: list[dict], n_hosts: int | None) -> dict | None:
+    """Work-efficiency distribution (performance attribution plane).
+
+    ``rows`` are per-window records carrying the RING_WORK columns — ring
+    records from the batched engines, or the CPU oracle's ``work`` rows.
+    With ``n_hosts`` known (the heartbeat ``work`` block or the final CLI
+    JSON carries it) the stats are FRACTIONS: active-host fraction
+    (active_hosts/H — the live signal for "0.1% of hosts pay full [cap, H]
+    plane passes"), pop-scan efficiency (events popped per pop lane
+    scanned, events/(rounds·H); sharded logs sum per-shard rounds, so
+    treat it as per-shard-lane efficiency there) and outbox-host fraction.
+    p50/p95/min — MIN because the worst window is the wasted-work story.
+    These stats are deliberately NOT part of ring_summary: the RING_WORK
+    columns are utilization samples, not counter deltas, and stay out of
+    the occupancy percentile table (the digest/retry-column rule)."""
+    series: dict[str, list] = {"active_frac": [], "pop_scan_eff": [],
+                               "outbox_frac": [], "active_hosts": [],
+                               "elig_events": []}
+    for r in rows:
+        if "active_hosts" not in r:
+            continue
+        series["active_hosts"].append(r["active_hosts"])
+        if "elig_events" in r:
+            series["elig_events"].append(r["elig_events"])
+        if n_hosts:
+            series["active_frac"].append(r["active_hosts"] / n_hosts)
+            if "outbox_hosts" in r:
+                series["outbox_frac"].append(r["outbox_hosts"] / n_hosts)
+            if r.get("rounds"):
+                series["pop_scan_eff"].append(
+                    r.get("events", 0) / (r["rounds"] * n_hosts))
+    if not series["active_hosts"]:
+        return None
+    out: dict = {"windows": len(series["active_hosts"])}
+    if n_hosts:
+        out["n_hosts"] = n_hosts
+    for name, vals in series.items():
+        if not vals:
+            continue
+        rnd = (lambda v: round(v, 6)) if n_hosts else (lambda v: v)
+        out[name] = {
+            "p50": rnd(percentile(vals, 50)),
+            "p95": rnd(percentile(vals, 95)),
+            "min": rnd(min(vals)),
+        }
+    return out
+
+
+def _print_work(ws: dict, out) -> None:
+    print(f"  windows: {ws['windows']}"
+          + (f"  n_hosts: {ws['n_hosts']}" if "n_hosts" in ws else ""),
+          file=out)
+    labels = {
+        "active_frac": "active-host fraction (active_hosts/H)",
+        "pop_scan_eff": "pop-scan efficiency (events/(rounds*H))",
+        "outbox_frac": "outbox-host fraction (outbox_hosts/H)",
+        "active_hosts": "active hosts (absolute)",
+        "elig_events": "eligible events (absolute)",
+    }
+    # Fractions when the host count is known; absolute counts otherwise.
+    keys = (("active_frac", "pop_scan_eff", "outbox_frac")
+            if "active_frac" in ws else ("active_hosts", "elig_events"))
+    for key in keys:
+        if key in ws:
+            d = ws[key]
+            print(f"  {labels[key]}: p50 {d['p50']}  p95 {d['p95']}  "
+                  f"min {d['min']}", file=out)
+
+
+def _log_n_hosts(recs: list[dict]) -> int | None:
+    """The host count, from the heartbeat ``work`` block (preferred) or the
+    CLI's final JSON record — the denominator the fractions need."""
+    for r in recs:
+        w = r.get("work")
+        if isinstance(w, dict) and w.get("n_hosts"):
+            return int(w["n_hosts"])
+    for r in recs:
+        if r.get("hosts") and isinstance(r.get("metrics"), dict):
+            return int(r["hosts"])
+    return None
+
+
 def ring_summary(rings: list[dict]) -> dict:
     """Per-window occupancy distribution: p50/p95/max for each ring field.
 
@@ -90,6 +173,7 @@ def summarize(recs: list[dict], out=None) -> dict:
     tr = [r for r in recs if r.get("type") == REC_TRACKER]
     rings = [r for r in recs if r.get("type") == REC_RING]
     gaps = [r for r in recs if r.get("type") == REC_RING_GAP]
+    works = [r for r in recs if r.get("type") == REC_WORK]
     fleet_exp = [r for r in recs if r.get("type") == REC_FLEET_EXP]
     summary: dict = {
         "heartbeats": len(hb),
@@ -301,6 +385,33 @@ def summarize(recs: list[dict], out=None) -> dict:
                     d = rs[field]
                     print(f"  {field}: p50 {d['p50']}  p95 {d['p95']}  "
                           f"max {d['max']}", file=out)
+        # Work-efficiency section (performance attribution plane): the
+        # RING_WORK columns as utilization distributions, per experiment
+        # under --fleet. Deliberately OUTSIDE ring_summary — utilization
+        # samples never enter the occupancy percentile table (the
+        # digest/retry-column rule).
+        n_hosts = _log_n_hosts(recs)
+        for exp_id, group in groups:
+            ws = work_summary(group, n_hosts)
+            if ws is None:
+                continue
+            tag = "" if exp_id is None else f", experiment {exp_id}"
+            if exp_id is None:
+                summary["work"] = ws
+            else:
+                summary.setdefault("work_by_exp", {})[exp_id] = ws
+            print(f"== work efficiency (wasted-work accounting{tag}) ==",
+                  file=out)
+            _print_work(ws, out)
+    elif works:
+        # CPU-oracle logs: the per-window ``work`` rows carry the same
+        # columns (no rounds, so no pop-scan efficiency).
+        ws = work_summary(works, _log_n_hosts(recs))
+        if ws is not None:
+            summary["work"] = ws
+            print("== work efficiency (wasted-work accounting) ==",
+                  file=out)
+            _print_work(ws, out)
     # Capacity advisory (tools/captune.py): measured peaks vs the caps the
     # records carry — the actionable line the cap-sizing debates need.
     from shadow1_tpu.tools import captune

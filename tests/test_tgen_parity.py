@@ -7,6 +7,7 @@ be exact including under loss.
 """
 
 import numpy as np
+import pytest
 
 from shadow1_tpu.config.compiled import single_vertex_experiment
 from shadow1_tpu.consts import MS, SEC, EngineParams
@@ -46,6 +47,10 @@ def test_tgen_mesh_parity():
     assert_parity(cm, cs, tm, ts, keys=TGEN_KEYS)
 
 
+@pytest.mark.slow  # tier-1 wall budget (PR 9): the 60-sim-second loss run;
+# loss+retransmit parity stays in the fast tier via
+# test_bitcoin_parity.test_bitcoin_flood_under_loss_parity and the rung-1
+# loss paths; ./ci.sh all runs this.
 def test_tgen_mesh_under_loss_parity():
     exp = tgen_exp(seed=8, loss=0.02, mean_bytes=30_000, end=60 * SEC)
     cm, cs, tm, ts = run_both(exp, EngineParams(ev_cap=256))

@@ -59,6 +59,23 @@ def tor_exp(seed=31, loss=0.0, end=30 * SEC, n_circuits=2, n_streams=2,
 PARAMS = EngineParams(ev_cap=256, sockets_per_host=32)
 
 
+def test_tor_circuits_parity_fast():
+    """Tier-1 wall sibling (PR 9 budget pass): one circuit and one stream
+    per client on a shorter horizon — the same full bootstrap → telescope →
+    stream → completion parity contract as the slow original below."""
+    exp = tor_exp(end=20 * SEC, n_circuits=1, n_streams=1, mean_cells=10.0)
+    cm, cs, tm, ts = run_both(exp, PARAMS)
+    n_clients = 12
+    assert int(ts["clients_done"]) == n_clients
+    assert int(ts["total_streams_done"]) == n_clients * 1 * 1
+    assert int(ts["total_cells_rx"]) > 0
+    assert int(ts["total_cells_fwd"]) > 0
+    assert int(ts["total_ct_overflow"]) == 0
+    assert_parity(cm, cs, tm, ts, keys=TOR_KEYS)
+
+
+@pytest.mark.slow  # tier-1 wall budget (PR 9): the full 2-circuit/2-stream
+# matrix; the fast sibling above keeps the contract in the fast tier.
 def test_tor_circuits_parity():
     exp = tor_exp()
     cm, cs, tm, ts = run_both(exp, PARAMS)
