@@ -20,7 +20,13 @@
 #                      (dense/sparse/fleet ms-per-round vs BENCH_GATE.json),
 #                      the opcensus eqn-drift gate (must trip on an
 #                      injected extra-op build) and a phaseprobe
-#                      attribution with >=90% coverage
+#                      attribution with >=90% coverage; plus the fleet
+#                      recovery smokes: fleetprobe --retry (under-capped
+#                      sweep retry == big-cap fleet per-lane digests,
+#                      cpu+tpu), a 3-trial chaosprobe fleet matrix
+#                      (kill-anywhere under forced overflow retry +
+#                      forced-lane-halt quarantine), and the quarantined
+#                      lane's checkpoint resuming solo bit-identically
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -31,7 +37,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_preempt.py tests/test_perfobs.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -150,6 +156,108 @@ print("fleetprobe: 3 experiments x", d["windows"],
       "windows bit-identical fleet<->solo on tpu and cpu sides")
 '
     rm -f "$fl_cfg"
+    echo "== fleet recovery smoke (transactional retry + lane quarantine) =="
+    # The PR 13 acceptance gates. (1) fleetprobe --retry: a deliberately
+    # under-capped sweep under --on-overflow retry must actually retry and
+    # every lane's committed digest stream must bit-match the straight
+    # big-cap fleet run (tpu side) AND the eager oracle at the final caps
+    # (cpu side) — the PR 5 solo proof, fleet-wide.
+    fr_cfg=$(mktemp /tmp/shadow1_fr_XXXX.yaml)
+    cat > "$fr_cfg" <<'YAML'
+general: {seed: 5, stop_time: 40 ms}
+engine: {scheduler: tpu, ev_cap: 8}
+network: {single_vertex: {latency: 1 ms}}
+hosts:
+  - {name: h, count: 8}
+app:
+  model: phold
+  params: {mean_delay_ns: 2000000.0, init_events: 6}
+sweep:
+  seeds: [5, 6, 7]
+YAML
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.fleetprobe \
+        "$fr_cfg" --retry --windows 20 --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+assert d["chunk_retries"] >= 1, d
+assert d["mismatches"] == [], d
+print("fleetprobe --retry:", d["chunk_retries"], "chunk(s) replayed,",
+      "final caps", d["final_caps"], "- per-lane digest parity with the",
+      "big-cap fleet (tpu) and the oracle (cpu)")
+'
+    # (2) Fleet-recovery chaos matrix (3 trials total): kill-anywhere +
+    # forced-overflow retry (2 trials), then forced-lane-halt quarantine
+    # (1 trial) — each relaunched to completion and bit-compared per
+    # surviving lane against the straight run; the quarantine trial must
+    # slice out exactly lane 1 on both sides.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.chaosprobe \
+        "$fr_cfg" --fleet --extra "--on-overflow retry" \
+        --windows 40 --chunk 10 --trials 2 --seed 1 --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"] and d["trials"] == 2, d
+print("chaosprobe fleet-retry matrix:", d["trials"],
+      "kill trials bit-identical under forced overflow retry")
+'
+    fq_cfg=$(mktemp /tmp/shadow1_fq_XXXX.yaml)
+    cat > "$fq_cfg" <<'YAML'
+general: {seed: 5, stop_time: 40 ms}
+engine: {scheduler: tpu, ev_cap: 8}
+network: {single_vertex: {latency: 1 ms}}
+hosts:
+  - {name: h, count: 8}
+app:
+  model: phold
+  params: {mean_delay_ns: 2000000.0, init_events: 6}
+sweep:
+  seeds: [5, 6, 7]
+  vary:
+    - {network: {single_vertex: {loss: 0.5}}}
+    - {}
+    - {network: {single_vertex: {loss: 0.5}}}
+YAML
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.chaosprobe \
+        "$fq_cfg" --fleet --extra "--on-overflow halt --on-lane-fail quarantine" \
+        --expect-quarantine 1 --windows 40 --chunk 10 --trials 1 --seed 2 \
+        --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"] and d["trials"] == 1, d
+assert d["quarantined"] == [1], d
+print("chaosprobe quarantine matrix: lane 1 quarantined, sweep completed",
+      "2/3, kill trial bit-identical")
+'
+    # (3) The quarantined lane's sliced checkpoint must resume SOLO: run
+    # the quarantine sweep once (quarantine snapshots land beside the
+    # --ckpt path), then load its .q1 snapshot into the solo engine.
+    fq_dir=$(mktemp -d /tmp/shadow1_fqd_XXXX)
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu \
+        "$fq_cfg" --fleet --on-overflow halt --on-lane-fail quarantine \
+        --windows 20 --ckpt "$fq_dir/q.npz" --supervised-child \
+        >"$fq_dir/q.out" 2>/dev/null
+    qck="$fq_dir/q.npz.q1.npz"
+    [ -f "$qck" ] || { echo "quarantine ckpt $qck missing" >&2; exit 1; }
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$fq_cfg" "$qck" <<'EOF'
+import json, sys
+import shadow1_tpu
+from shadow1_tpu.ckpt import load_state
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.fleet.expand import load_sweep
+import numpy as np
+
+plan = load_sweep(sys.argv[1])
+exp, params = plan.exps[1], plan.params
+solo = Engine(exp, params)
+lane = load_state(solo.init_state(), sys.argv[2])
+w0 = int(np.asarray(lane.win_start)) // solo.window
+st = solo.run(lane, n_windows=20 - w0)
+straight = Engine(exp, params).run(n_windows=20)
+assert Engine.metrics_dict(st) == Engine.metrics_dict(straight)
+print(f"quarantined-lane ckpt resumed solo from window {w0}: final "
+      f"metrics bit-match the straight solo run")
+EOF
+    rm -rf "$fr_cfg" "$fq_cfg" "$fq_dir"
     echo "== preemption smoke (SIGTERM drain + kill-anywhere chaos trials) =="
     # SIGTERM mid-run must commit the in-flight chunk, write a final
     # snapshot and exit the documented preempted code (consts.py taxonomy);

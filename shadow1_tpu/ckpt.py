@@ -271,7 +271,9 @@ def snapshot_caps(template, path: str) -> tuple[int, int] | None:
             f"checkpoint {path} is unreadable ({type(e).__name__}: {e}) — "
             f"truncated or damaged snapshot; discard it and re-run"
         ) from e
-    if len(ev) != 2 or len(ob) != 2:
+    # Slot axis is axis=-2 on solo ([C, H]) and fleet ([E, C, H]) planes
+    # alike (the tune/resize.py convention).
+    if len(ev) < 2 or len(ob) < 2:
         return None
     return int(ev[-2]), int(ob[-2])
 

@@ -433,13 +433,22 @@ def _supervise(child_argv, ckpt_path, config_path,
 
 
 def _fleet_main(args, params, plan, log, t0, capacity_exit,
-                preempted_exit, memory_exit=None, sub_batch=None) -> int:
+                preempted_exit, memory_exit=None, sub_batch=None,
+                auto_caps=False, pre_downshift_retry=False) -> int:
     """The --fleet execution path: one FleetEngine run over the expanded
     sweep, per-experiment final records + a fleet summary on stdout
     (docs/OBSERVABILITY.md §"Fleet records"). ``sub_batch`` (set by the
     --on-oom downshift planner) routes to the sequential sub-batched
     runner instead; ``memory_exit`` maps a runtime RESOURCE_EXHAUSTED to
-    the structured EXIT_MEMORY taxonomy."""
+    the structured EXIT_MEMORY taxonomy. The recovery plane (retry /
+    quarantine / lane finalize / auto-caps) is driven by ``params`` inside
+    fleet.run.run_fleet; this layer resolves resume state — including a
+    lineage generation whose ``lanes`` meta says the sweep had already
+    quarantined/finalized lanes (rebuild exactly that sub-fleet) and
+    snapshots carrying retry/auto-caps-grown caps (rebuild at the
+    snapshot's caps, mirroring the solo path)."""
+    import os as _os
+
     import jax
     import numpy as np
 
@@ -452,8 +461,30 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
     if sub_batch and sub_batch < len(plan.exps):
         return _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
                                  preempted_exit, memory_exit, sub_batch)
+    # Resume resolution FIRST: a lineage generation carries the surviving
+    # lane ids (``lanes`` manifest meta) when the sweep had already
+    # quarantined or finalized lanes — the engine must be built for
+    # exactly that sub-fleet or the [E', ...] snapshot cannot load.
+    resolved, ckpt_lineage, resume_path = _resolve_ckpt_lineage(
+        args, log, what="fleet checkpoint")
+    exps, labels, max_rounds = plan.exps, plan.labels, plan.max_rounds
+    meta_lanes = (resolved.meta or {}).get("lanes") if resolved else None
+    sub_applied = False
+    if meta_lanes is not None and \
+            list(meta_lanes) != [l["exp"] for l in plan.labels]:
+        sub = plan.subset(meta_lanes)
+        exps, labels, max_rounds = sub.exps, sub.labels, sub.max_rounds
+        sub_applied = True
+        log.info("resuming partially-recovered sweep",
+                 lanes=list(meta_lanes), of=len(plan.exps))
+
+    def _build(p):
+        eng = FleetEngine(exps, p, max_rounds)
+        eng.exp_ids = [l["exp"] for l in labels]
+        return eng
+
     try:
-        eng = FleetEngine(plan.exps, params, plan.max_rounds)
+        eng = _build(params)
     except Exception as e:
         if memory_exit is not None and mem.is_oom(e):
             return memory_exit(e, phase="init")
@@ -461,30 +492,55 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
     log.info("fleet expanded", experiments=eng.n_exp,
              hosts=eng.exp.n_hosts, window_ns=eng.window)
     st = None
-    metrics0 = None
-    # Same resume precedence as the solo path: a --ckpt snapshot on disk
-    # (the newer state a supervised respawn continues from) wins over an
-    # explicit --resume, resolved through the lineage to the newest VALID
-    # generation. The snapshot is the WHOLE fleet ([E, ...] leaves).
-    resolved, ckpt_lineage, resume_path = _resolve_ckpt_lineage(
-        args, log, what="fleet checkpoint")
+    metrics0_by_gid = None
     if resume_path:
-        from shadow1_tpu.ckpt import CorruptCheckpointError, load_state
+        from shadow1_tpu.ckpt import (
+            CorruptCheckpointError,
+            load_state,
+            snapshot_caps,
+        )
 
+        params0, eng0 = params, eng
         try:
-            st = load_state(eng.init_state(), resume_path)
+            template = eng.init_state()
+            if (auto_caps or params.on_overflow == "retry"
+                    or pre_downshift_retry):
+                # Retry/auto-caps runs checkpoint at whatever cap they had
+                # grown to — rebuild the fleet engine at the snapshot's
+                # caps before loading (the solo-path recipe; a shrink-on-
+                # load that would drop events refuses otherwise).
+                snap = snapshot_caps(template, resume_path)
+                if snap and snap != (params.ev_cap, params.outbox_cap):
+                    import dataclasses
+
+                    params = dataclasses.replace(
+                        params, ev_cap=snap[0], outbox_cap=snap[1])
+                    eng = _build(params)
+                    template = eng.init_state()
+            st = load_state(template, resume_path)
         except CorruptCheckpointError as e:
             # Same policy as the solo path: a supervised child must not
             # crash-loop the respawn budget on a snapshot corrupted after
             # the parent's pre-spawn verification — fall back to a fresh
-            # start. An explicit --resume keeps failing loudly.
+            # start. An explicit --resume keeps failing loudly. A fresh
+            # start means the FULL sweep: the discarded generation's
+            # ``lanes`` subset (and its quarantine ledger) dies with it —
+            # every lane re-earns its fate from window 0.
             if resolved is None:
                 raise
             log.warning("discarding corrupt fleet checkpoint",
                         path=resume_path, reason=str(e))
             st, resume_path, resolved = None, None, None
+            params = params0
+            if sub_applied:
+                exps, labels, max_rounds = (plan.exps, plan.labels,
+                                            plan.max_rounds)
+                eng = _build(params)
+            else:
+                eng = eng0
         else:
-            metrics0 = eng.metrics_per_exp(st)
+            metrics0_by_gid = {l["exp"]: m for l, m in
+                               zip(labels, eng.metrics_per_exp(st))}
             done = int(np.asarray(st.win_start).max()) // eng.window
             if resolved is not None:
                 _emit_resume_record(args.ckpt, resolved,
@@ -498,12 +554,15 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
                 args.windows = max(args.windows - done, 0)
     ring_w = params.metrics_ring
     drain = DrainHandler().install()
+    # Quarantined-lane snapshots land beside the fleet checkpoint; a
+    # checkpoint-less run keeps them beside the config (full path kept —
+    # never the process cwd).
+    qbase = args.ckpt or _os.path.splitext(args.config)[0] + ".lane"
+    hb = None
     try:
-        import os as _os
-
         if _os.environ.get("SHADOW1_MEM_INJECT_OOM") == "run":
             raise RuntimeError("RESOURCE_EXHAUSTED: injected (test hook)")
-        st, _hb = run_fleet(
+        st, hb = run_fleet(
             eng, st, n_windows=args.windows,
             every_windows=args.heartbeat or (ring_w or None),
             stream=None if (args.heartbeat or ring_w) else False,
@@ -511,9 +570,21 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
             emit_heartbeat=bool(args.heartbeat),
             emit_ring=bool(ring_w),
             selfcheck=bool(params.selfcheck),
-            labels=plan.labels,
+            labels=labels,
             ckpt_keep=args.ckpt_keep,
             drain=drain,
+            auto_caps=auto_caps,
+            quarantine_base=qbase,
+            recovery_seed=({"quarantined":
+                            (resolved.meta or {}).get("quarantined", []),
+                            "finished":
+                            (resolved.meta or {}).get("finished", [])}
+                           if resolved is not None and st is not None
+                           else None),
+            # Quarantine / early-finalize records print to stdout the
+            # moment the lane leaves the fleet — its fleet_exp would
+            # otherwise never appear.
+            emit_record=lambda rec: print(json.dumps(rec), flush=True),
         )
         jax.block_until_ready(st)
     except CapacityExceededError as e:
@@ -530,9 +601,14 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
         save_state(st, args.save_state)
     wall = time.perf_counter() - t0
     n_windows = args.windows if args.windows is not None else eng.n_windows
-    recs, summary = final_records(eng, st, plan.labels, n_windows, wall,
+    # The live fleet shape (lanes may have left mid-sweep) is on the
+    # heartbeat; rate baselines re-align by global id.
+    metrics0 = ([metrics0_by_gid.get(l["exp"], {}) for l in hb.labels]
+                if metrics0_by_gid is not None else None)
+    recs, summary = final_records(hb.engine, st, hb.labels, n_windows, wall,
                                   resumed=bool(resume_path),
-                                  metrics0=metrics0)
+                                  metrics0=metrics0,
+                                  recovery=hb.recovery)
     for r in recs:
         print(json.dumps(r))
     print(json.dumps(summary))
@@ -553,10 +629,21 @@ def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
     (tools/memprobe.py --subbatch is the per-invocation proof, the fleet
     contract's fleetprobe idiom). Each batch prints its fleet_exp records
     with SWEEP-GLOBAL experiment ids (FleetEngine.exp_base) as it
-    finishes; one merged fleet_summary closes the run. --ckpt/--resume
-    were refused by the downshift planner (a sub-batched sweep has no
-    single all-lane snapshot). A drain request between batches stops the
-    sweep there — finished lanes keep their records."""
+    finishes; one merged fleet_summary closes the run.
+
+    ``--ckpt`` composes: each batch checkpoints ITS OWN [k, ...] state,
+    with the sub-batch cursor riding the lineage manifest entry
+    (``batch`` + ``batch_summaries`` — completed batches' summaries, so
+    the merged fleet_summary survives a crash) beside the batch's
+    ``lanes``. A resume rebuilds the engine for the recorded batch, loads
+    its snapshot, finishes it and continues the remaining batches —
+    completed batches never re-run. (--resume/--save-state still refuse
+    at the downshift planner: an explicit snapshot path has no cursor.)
+    A drain request stops the sweep at a batch/chunk boundary — finished
+    lanes keep their records."""
+    import os as _os
+
+    import numpy as np
     import jax
 
     from shadow1_tpu import mem
@@ -575,36 +662,110 @@ def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
     summaries: list[dict] = []
     windows_done = args.windows
     lanes_run = 0
-    for i in range(0, E, sub):
+    # Per-batch resume: the newest lineage generation names the batch it
+    # snapshots and carries the summaries of every COMPLETED batch.
+    resolved, ckpt_lineage, resume_path = _resolve_ckpt_lineage(
+        args, log, what="sub-batched fleet checkpoint")
+    start_batch = 0
+    if resume_path and resolved is not None and resolved.meta:
+        start_batch = int(resolved.meta.get("batch", 0))
+        summaries = list(resolved.meta.get("batch_summaries", []))
+        lanes_run = start_batch * sub
+    for bi, i in enumerate(range(0, E, sub)):
+        if bi < start_batch:
+            continue  # completed pre-crash; its summary rode the manifest
         exps = plan.exps[i:i + sub]
         labels = plan.labels[i:i + sub]
+        max_rounds = plan.max_rounds[i:i + sub]
+        recovery_seed = None
+        st = None
+        batch_resumed = False
+        resuming_here = resume_path and bi == start_batch
+        if resuming_here and resolved is not None and resolved.meta:
+            # The generation may snapshot a batch that already
+            # quarantined/finalized lanes — rebuild exactly that
+            # sub-batch or the [k', ...] snapshot cannot load
+            # (the _fleet_main lanes-meta recipe, per batch).
+            meta_lanes = resolved.meta.get("lanes")
+            if meta_lanes is not None and \
+                    list(meta_lanes) != [l["exp"] for l in labels]:
+                by_gid = {l["exp"]: j for j, l in enumerate(labels)}
+                keep = [by_gid[g] for g in meta_lanes if g in by_gid]
+                exps = [exps[j] for j in keep]
+                max_rounds = [max_rounds[j] for j in keep]
+                labels = [labels[j] for j in keep]
+            recovery_seed = {
+                "quarantined": resolved.meta.get("quarantined", []),
+                "finished": resolved.meta.get("finished", [])}
         try:
-            eng = FleetEngine(exps, params, plan.max_rounds[i:i + sub])
+            eng = FleetEngine(exps, params, max_rounds)
             eng.exp_base = i
-            st, _hb = run_fleet(
-                eng, None, n_windows=args.windows,
+            eng.exp_ids = [l["exp"] for l in labels]
+            n_windows = (args.windows if args.windows is not None
+                         else eng.n_windows)
+            remaining = n_windows
+            if resuming_here:
+                from shadow1_tpu.ckpt import (
+                    CorruptCheckpointError,
+                    load_state,
+                )
+
+                try:
+                    st = load_state(eng.init_state(), resume_path)
+                except CorruptCheckpointError as e:
+                    if resolved is None:
+                        raise
+                    log.warning("discarding corrupt sub-batch checkpoint",
+                                path=resume_path, reason=str(e))
+                    st = None
+                    recovery_seed = None
+                    # Fresh restart of THIS batch = the full batch again.
+                    exps = plan.exps[i:i + sub]
+                    labels = plan.labels[i:i + sub]
+                    eng = FleetEngine(exps, params,
+                                      plan.max_rounds[i:i + sub])
+                    eng.exp_base = i
+                else:
+                    batch_resumed = True
+                    done = (int(np.asarray(st.win_start).max())
+                            // eng.window)
+                    remaining = max(n_windows - done, 0)
+                    _emit_resume_record(args.ckpt, resolved,
+                                        int(np.asarray(st.win_start).max()),
+                                        ckpt_lineage)
+            st, hb = run_fleet(
+                eng, st, n_windows=remaining,
                 every_windows=args.heartbeat or (ring_w or None),
                 stream=None if (args.heartbeat or ring_w) else False,
+                ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
                 emit_heartbeat=bool(args.heartbeat),
                 emit_ring=bool(ring_w),
                 selfcheck=bool(params.selfcheck),
                 labels=labels,
+                ckpt_keep=args.ckpt_keep,
                 drain=drain,
+                quarantine_base=(args.ckpt or _os.path.splitext(
+                    args.config)[0] + ".lane"),
+                emit_record=lambda rec: print(json.dumps(rec), flush=True),
+                resume_meta={"batch": bi, "batch_summaries": summaries},
+                recovery_seed=recovery_seed,
             )
             jax.block_until_ready(st)
         except CapacityExceededError as e:
             return capacity_exit(e)
         except PreemptedExit as e:
-            return preempted_exit(e, resumed=False)
+            return preempted_exit(e, resumed=batch_resumed)
         except Exception as e:
             if memory_exit is not None and mem.is_oom(e):
                 return memory_exit(e)
             raise
-        n_windows = (args.windows if args.windows is not None
-                     else eng.n_windows)
         windows_done = n_windows
-        recs, summary = final_records(eng, st, labels, n_windows,
-                                      time.perf_counter() - t0)
+        # The LIVE batch shape: quarantine/finalize policies ride params
+        # into run_fleet and may have shrunk the batch mid-run.
+        recs, summary = final_records(hb.engine, st, hb.labels, n_windows,
+                                      time.perf_counter() - t0,
+                                      resumed=batch_resumed,
+                                      recovery=hb.recovery)
         for r in recs:
             print(json.dumps(r))
         summaries.append(summary)
@@ -618,7 +779,7 @@ def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
                 st=None, signame=drain.signame,
                 done_windows=n_windows,
                 win_start=int(summary.get("sim_seconds", 0) * 1e9)),
-                resumed=False)
+                resumed=batch_resumed)
     # Merged fleet_summary: counters sum, gauges (and the lockstep
     # windows/rounds) max across batches — the same aggregation rule as
     # FleetEngine.metrics_dict, applied one level up.
@@ -764,6 +925,26 @@ def main(argv=None) -> int:
                          "to halt), shrink the telemetry ring, split a "
                          "fleet into sequential sub-batches (per-lane "
                          "digest streams stay bit-identical)")
+    ap.add_argument("--on-lane-fail", choices=["halt", "quarantine"],
+                    default=None, metavar="halt|quarantine",
+                    help="fleet lane-failure policy (shadow1_tpu/fleet/"
+                         "run.py; overrides engine.on_lane_fail). halt "
+                         "(default) = a deterministically failing lane "
+                         "(capacity halt / retry-ladder exhaustion / "
+                         "per-lane selfcheck violation) kills the whole "
+                         "sweep with the solo exit taxonomy; quarantine = "
+                         "slice the lane out of the chunk-start state into "
+                         "a solo-resumable checkpoint + a fleet_quarantine "
+                         "record, repack the survivors (bit-exact streams) "
+                         "and finish the sweep at E-k/E")
+    ap.add_argument("--lane-finalize", action="store_true",
+                    help="fleet mid-sweep lane lifecycle: lanes whose "
+                         "event buffer fully drains (per-lane stop "
+                         "horizon passed, nothing can ever fire again) "
+                         "emit their fleet_exp record immediately and are "
+                         "sliced out at chunk boundaries, shrinking the "
+                         "device program to the lanes still doing work "
+                         "(overrides engine.lane_finalize)")
     ap.add_argument("--selfcheck", action="store_true",
                     help="verify the drop-accounting identity (every sent "
                          "packet reaches exactly one counted fate) at every "
@@ -827,6 +1008,18 @@ def main(argv=None) -> int:
         import dataclasses
 
         params = dataclasses.replace(params, selfcheck=1)
+    if args.on_lane_fail is not None or args.lane_finalize:
+        import dataclasses
+
+        if not args.fleet:
+            ap.error("--on-lane-fail/--lane-finalize are fleet lane "
+                     "policies; they need --fleet")
+        repl = {}
+        if args.on_lane_fail is not None:
+            repl["on_lane_fail"] = args.on_lane_fail
+        if args.lane_finalize:
+            repl["lane_finalize"] = 1
+        params = dataclasses.replace(params, **repl)
     auto_caps = bool(args.auto_caps or params.auto_caps)
     if engine_kind == "cpu" and (args.save_state or args.resume
                                  or args.heartbeat or args.tracker
@@ -865,17 +1058,11 @@ def main(argv=None) -> int:
                 f"experiment axis yet (run the sweep's experiments solo "
                 f"on that engine, or drop --engine)", kind="mode",
                 knob="engine"))
-        if auto_caps:
-            return _fleet_config_exit(FleetConfigError(
-                "--auto-caps is not available under --fleet: cap "
-                "migration is per-experiment host-side state surgery; "
-                "size caps from a sweep captune pass instead", kind="mode",
-                knob="auto_caps"))
-        if params.on_overflow == "retry":
-            return _fleet_config_exit(FleetConfigError(
-                "--on-overflow retry is not available under --fleet; use "
-                "halt (names the overflowing experiment) or size caps "
-                "with captune", kind="mode", knob="on_overflow"))
+        # --auto-caps and --on-overflow retry were kind="mode" rejections
+        # through PR 12 — both now run fleet-wide (the [E, ...] pytree is
+        # the transaction unit; docs/SEMANTICS.md §"Fleet recovery
+        # contract"), so the only remaining mode rejection is the engine
+        # selector above.
         # Validate the sweep BEFORE any supervision/backend work: a
         # malformed sweep must fail once in the parent, not crash-loop
         # supervised children.
@@ -1007,12 +1194,18 @@ def main(argv=None) -> int:
                         pre_downshift_retry = params.on_overflow == "retry"
                         # --save-state gates like --ckpt/--resume: a
                         # shrunk ring would write a snapshot no
-                        # same-config engine could load back, and a
-                        # sub-batched fleet has no all-lane state to save.
+                        # same-config engine could load back. Sub-batching
+                        # DOES compose with --ckpt (per-batch snapshots +
+                        # the batch cursor in the lineage manifest) but
+                        # not with an explicit --resume/--save-state path,
+                        # which has no cursor and no all-lane state.
                         params, sub_batch, ds_actions = mem.downshift(
                             est_exp, params, n_exp, budget, n_dev=n_dev,
                             resumable=bool(args.ckpt or args.resume
-                                           or args.save_state))
+                                           or args.save_state),
+                            subbatch_resumable=bool(
+                                args.ckpt and not args.resume
+                                and not args.save_state))
                     except mem.MemoryBudgetError as e:
                         return _memory_exit(e)
                     ds_est = mem.estimate(est_exp, params,
@@ -1081,7 +1274,9 @@ def main(argv=None) -> int:
         try:
             return _fleet_main(args, params, fleet_plan, log, t0,
                                _capacity_exit, _preempted_exit,
-                               _memory_exit_runtime, sub_batch=sub_batch)
+                               _memory_exit_runtime, sub_batch=sub_batch,
+                               auto_caps=auto_caps,
+                               pre_downshift_retry=pre_downshift_retry)
         except FleetConfigError as e:
             # Late rejections (FleetEngine construction) use the same
             # structured exit as the early validation block above.

@@ -223,6 +223,25 @@ class EngineParams:
     # structured CapacityExceededError with paste-ready cap advice.
     # Inert on the eager CPU oracle except "halt" (boundary check only).
     on_overflow: str = "drop"
+    # Fleet lane-failure policy (fleet/run.py; CLI --on-lane-fail): what a
+    # fleet run does when ONE lane deterministically fails at a chunk
+    # boundary (capacity halt / retry-ladder exhaustion attributed to the
+    # lane, or a per-lane selfcheck violation). "halt" (default) raises —
+    # the whole sweep dies with the solo error/exit taxonomy; "quarantine"
+    # slices the failing lane out of the chunk-START state into a
+    # solo-resumable checkpoint plus a structured fleet_quarantine record,
+    # repacks the survivors into an E-1 fleet (re-jit; survivor digest
+    # streams provably unchanged — lanes are vmap-independent) and replays
+    # the chunk, finishing the sweep at E-k/E. Inert on solo engines.
+    on_lane_fail: str = "halt"
+    # Mid-sweep lane finalization (fleet/run.py; CLI --lane-finalize):
+    # 1 = at committed chunk boundaries, lanes whose event buffer has fully
+    # drained (no live event anywhere — nothing can ever fire again) are
+    # finalized early: their fleet_exp final record is emitted immediately
+    # and they are sliced out of the fleet the quarantine way, so the
+    # device program shrinks to the lanes still doing work. 0 (default) =
+    # every lane runs the full window count. Inert on solo engines.
+    lane_finalize: int = 0
     # In-run self-check (txn.check_boundary_identity; CLI --selfcheck):
     # 1 = verify the drop-accounting identity (every sent packet reaches
     # exactly one counted fate) at every chunk boundary (batched engines)
@@ -263,6 +282,8 @@ class EngineParams:
         assert self.state_digest in (0, 1), self.state_digest
         assert self.auto_caps >= 0, self.auto_caps
         assert self.on_overflow in ("drop", "retry", "halt"), self.on_overflow
+        assert self.on_lane_fail in ("halt", "quarantine"), self.on_lane_fail
+        assert self.lane_finalize in (0, 1), self.lane_finalize
         assert self.selfcheck in (0, 1), self.selfcheck
         assert self.pop_impl in ("xla", "pallas"), self.pop_impl
         assert self.push_impl in ("xla", "pallas"), self.push_impl

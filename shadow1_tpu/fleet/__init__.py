@@ -5,10 +5,15 @@
 ``engine``  — FleetEngine: E experiment variants vmapped over a leading
               experiment axis through the single-device window loop.
 ``run``     — the chunked fleet runner (per-experiment ring drain,
-              heartbeats, checkpoints, per-experiment final records).
+              heartbeats, checkpoints, per-experiment final records) and
+              the fleet RECOVERY plane: transactional overflow retry over
+              the whole [E, ...] pytree, lane quarantine
+              (--on-lane-fail), mid-sweep lane finalization
+              (--lane-finalize), fleet-global --auto-caps.
 
-Contract: docs/SEMANTICS.md §"Fleet contract"; record schemas:
-docs/OBSERVABILITY.md §"Fleet records".
+Contracts: docs/SEMANTICS.md §"Fleet contract" + §"Fleet recovery
+contract"; record schemas: docs/OBSERVABILITY.md §"Fleet records" +
+§"Fleet recovery records".
 """
 
 from shadow1_tpu.fleet.expand import (  # noqa: F401

@@ -26,13 +26,17 @@ lanes cannot interact), and the batched ``while_loop`` freezes finished
 lanes with per-lane selects, so even per-lane ``rounds`` counts stay
 exact.
 
-What the fleet plane deliberately rejects (structured FleetConfigError,
+**Recovery plane** (docs/SEMANTICS.md §"Fleet recovery contract"): the
+``[E, ...]`` state pytree is a well-defined transaction unit, so
+``--on-overflow retry`` rolls the WHOLE fleet back to the chunk-start
+state, grows the (fleet-uniform) cap one ladder step via the leading-axis-
+aware ``tune/resize.py`` migration and replays the chunk bit-exactly;
+``--auto-caps`` feeds the CapController fleet-global gauges (max fill over
+lanes, summed overflow); and failing/finished lanes are sliced out
+mid-sweep (``select_lanes`` / ``lane_done`` — fleet/run.py drives the
+policy). What the fleet plane still rejects (structured FleetConfigError,
 ``kind="mode"``): the sharded engine (vmap-over-shard_map composition is a
-follow-up), ``--auto-caps`` and ``--on-overflow retry`` (cap migration is
-host-side state surgery per lane; growing for ALL lanes on one lane's
-overflow would silently change every other lane's cost envelope — run the
-sweep at captune'd caps instead, or use ``halt`` which names the offending
-experiment). Pallas kernel impls and sparse-window compaction downgrade to
+follow-up). Pallas kernel impls and sparse-window compaction downgrade to
 their XLA/full-width twins with a warning (bit-identical by contract).
 """
 
@@ -70,6 +74,18 @@ def slice_experiment(st: SimState, e: int) -> SimState:
     return jax.tree.map(lambda x: x[e], st)
 
 
+def select_lanes(st: SimState, keep) -> SimState:
+    """The sub-fleet state holding only lanes ``keep`` (local indices, in
+    order): every leaf's leading experiment axis gathered down to E'. Lanes
+    are vmap-independent — the batched program applies the identical
+    per-lane computation to whatever rides the axis — so a kept lane's
+    continuation from a selected state is bit-identical to its continuation
+    in the full fleet (the quarantine / early-finalize repack primitive;
+    tests/test_fleet_recover.py proves it against E-1-from-scratch runs)."""
+    idx = np.asarray(list(keep), np.int32)
+    return jax.tree.map(lambda x: x[idx], st)
+
+
 def fleet_metrics_per_exp(st: SimState) -> list[dict[str, int]]:
     """Per-experiment metric dicts from a fleet state ([E] leaves)."""
     arrs = {k: np.asarray(v) for k, v in st.metrics._asdict().items()}
@@ -78,16 +94,18 @@ def fleet_metrics_per_exp(st: SimState) -> list[dict[str, int]]:
 
 
 def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0,
-                      exp_base: int = 0) -> list[dict]:
+                      exp_base: int = 0, exp_ids=None) -> list[dict]:
     """Per-experiment telemetry-ring drain: the solo ``drain_ring`` per
     lane, each record tagged with its experiment id (``exp``) — the shape
     tools/heartbeat_report.py and captune group by (docs/OBSERVABILITY.md
     §fleet). ``exp_base`` offsets the ids: a memory-downshifted sub-batch
     (cli --on-oom downshift) runs lanes [base, base+k) of the sweep, and
-    its ring records must carry the SWEEP-global experiment ids. TWO
-    device→host fetches total (the [E, W, F] ring and the window
-    counters), then pure numpy lane views — never a per-lane slice of the
-    whole fleet state."""
+    its ring records must carry the SWEEP-global experiment ids;
+    ``exp_ids`` (explicit per-lane global ids, wins over exp_base) is the
+    same need after mid-sweep quarantine/finalize leaves the surviving ids
+    non-contiguous. TWO device→host fetches total (the [E, W, F] ring and
+    the window counters), then pure numpy lane views — never a per-lane
+    slice of the whole fleet state."""
     from types import SimpleNamespace
 
     from shadow1_tpu.telemetry.ring import drain_ring
@@ -102,8 +120,9 @@ def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0,
             telem=SimpleNamespace(buf=buf[e]),
             metrics=SimpleNamespace(windows=int(windows[e])),
         )
+        gid = exp_ids[e] if exp_ids is not None else e + exp_base
         for r in drain_ring(lane, window_ns, start=start):
-            recs.append({**r, "exp": e + exp_base})
+            recs.append({**r, "exp": int(gid)})
     return recs
 
 
@@ -210,6 +229,11 @@ class FleetEngine:
         # Sweep-global id of lane 0 — nonzero only for a memory-downshifted
         # sub-batch (cli --on-oom downshift), so records keep global ids.
         self.exp_base = 0
+        # Explicit per-lane sweep-global ids (wins over exp_base when set):
+        # after a mid-sweep quarantine/finalize the surviving ids are
+        # non-contiguous, and every ring record must still carry the id the
+        # lane had in the original sweep (fleet/run.py sets this on repack).
+        self.exp_ids: list[int] | None = None
         self._model = _model_module(self.exp.model)
         self._base_ctx = build_base_ctx(self.exp, self.params,
                                         window=self.window)
@@ -233,21 +257,11 @@ class FleetEngine:
 
     # -- construction ------------------------------------------------------
     def _resolve_fleet_params(self, params: EngineParams) -> EngineParams:
-        if params.auto_caps:
-            raise FleetConfigError(
-                "auto_caps is not available under --fleet: between-chunk "
-                "cap migration is per-lane host-side state surgery, and a "
-                "fleet-wide grow driven by one experiment would change "
-                "every other experiment's cost envelope. Size caps from a "
-                "sweep captune pass instead (tools/captune.py groups "
-                "verdicts per experiment).", kind="mode", knob="auto_caps")
-        if params.on_overflow == "retry":
-            raise FleetConfigError(
-                "on_overflow=retry is not available under --fleet (chunk "
-                "rollback + cap growth is per-lane state surgery); use "
-                "on_overflow=halt — it names the overflowing experiment — "
-                "or size caps with captune.", kind="mode",
-                knob="on_overflow")
+        # auto_caps and on_overflow=retry were structured kind="mode"
+        # rejections through PR 12: both now work fleet-wide — the [E, ...]
+        # pytree is the transaction unit, caps stay fleet-uniform, and
+        # tune/resize.py migrates the batched planes per lane (PR 13,
+        # docs/SEMANTICS.md §"Fleet recovery contract").
         repl = {}
         if "pallas" in (params.pop_impl, params.push_impl):
             import warnings
@@ -395,7 +409,26 @@ class FleetEngine:
 
     def drain_rings(self, st: SimState, start: int = 0) -> list[dict]:
         return drain_fleet_rings(st, self.window, start=start,
-                                 exp_base=self.exp_base)
+                                 exp_base=self.exp_base,
+                                 exp_ids=self.exp_ids)
+
+    @staticmethod
+    def lane_done(st: SimState) -> np.ndarray:
+        """Per-lane "nothing can ever happen again" flags ([E] bool).
+
+        A lane is DONE when its event buffer holds no live event: all event
+        creation flows from handling events (model init seeds the first
+        ones; restart resets restore model columns but never push), and
+        chunk boundaries always see an empty outbox (cleared at window
+        end), so an empty buffer means every further window is a pure
+        no-op on the lane's model/digest state. The basis of --lane-finalize
+        (fleet/run.py slices such lanes out and emits their final record
+        immediately). One [E, C, H] host fetch — call at chunk boundaries
+        only, and only when the policy is on."""
+        from shadow1_tpu.consts import K_NONE
+
+        kind = np.asarray(st.evbuf.kind)          # [E, C, H]
+        return ~(kind != K_NONE).any(axis=(-2, -1))
 
     def model_summary(self, st: SimState, e: int) -> dict[str, Any]:
         lane = slice_experiment(st, e)

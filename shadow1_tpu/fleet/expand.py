@@ -186,6 +186,23 @@ class FleetPlan:
     def n_exp(self) -> int:
         return len(self.exps)
 
+    def subset(self, exp_ids) -> "FleetPlan":
+        """The sub-fleet plan holding only the sweep-global experiment
+        ids ``exp_ids``, in that order — what a resume builds when a
+        lineage generation's ``lanes`` meta says the sweep had already
+        quarantined/finalized lanes (cli._fleet_main; ids absent from
+        the plan are ignored so a stale manifest cannot crash the
+        resume)."""
+        by_gid = {l["exp"]: i for i, l in enumerate(self.labels)}
+        keep = [by_gid[g] for g in exp_ids if g in by_gid]
+        return FleetPlan(
+            exps=[self.exps[i] for i in keep],
+            params=self.params,
+            max_rounds=[self.max_rounds[i] for i in keep],
+            scheduler=self.scheduler,
+            labels=[self.labels[i] for i in keep],
+        )
+
 
 # EngineParams fields allowed to differ between fleet experiments. Every
 # other field is shape-affecting or trace-structural (caps pick tensor

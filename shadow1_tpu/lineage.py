@@ -135,7 +135,12 @@ class Lineage:
 
         ``meta`` (win_start / done_windows / total) rides the manifest entry
         so resume tooling and heartbeat_report can line generations up with
-        sim time without opening the .npz files."""
+        sim time without opening the .npz files. EXTRA meta keys pass
+        through verbatim — the fleet recovery plane stores the surviving
+        lane ids (``lanes``) and the sub-batch cursor (``batch`` /
+        ``batch_summaries``) this way, so a resume knows which sub-fleet a
+        generation snapshots without a second sidecar that could go stale
+        against it (cli._fleet_main / _fleet_subbatched)."""
         import numpy as np
 
         from shadow1_tpu import ckpt as _ckpt
@@ -174,6 +179,11 @@ class Lineage:
                 "outbox_cap": int(np.asarray(st.outbox.dst).shape[-2]),
             },
         }
+        if meta:
+            # Extra keys (fleet lanes / sub-batch cursor) ride verbatim;
+            # the canonical ints above stay canonical.
+            entry.update({k: v for k, v in meta.items()
+                          if k not in entry})
         entries.append(entry)
         entries.sort(key=lambda e: e["seq"])
         # 4) Prune beyond ``keep`` (head included in the count).

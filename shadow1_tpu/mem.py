@@ -482,7 +482,7 @@ def check_budget(est: MemEstimate, budget: int | None,
 
 
 def downshift(exp, params, n_exp: int, budget: int, n_dev: int = 1,
-              resumable: bool = False):
+              resumable: bool = False, subbatch_resumable: bool = False):
     """The graceful-degradation planner (CLI ``--on-oom downshift``).
 
     Applies the bit-exactness-preserving downshifts IN ORDER until the
@@ -502,9 +502,13 @@ def downshift(exp, params, n_exp: int, budget: int, n_dev: int = 1,
     3. **sub-batch the fleet** — split E lanes into sequential batches of
        the largest k that fits; lanes are independent, so each lane's
        digest stream/metrics are bit-identical to the full-E run
-       (tools/memprobe.py --subbatch proves it per invocation). Refused
-       when ``resumable``: a sub-batched sweep has no single all-lane
-       snapshot to resume from.
+       (tools/memprobe.py --subbatch proves it per invocation). With
+       ``subbatch_resumable`` (the CLI sets it for plain ``--ckpt`` runs)
+       sub-batching composes with checkpointing: each batch snapshots its
+       own [k, ...] state with the batch cursor riding the lineage
+       manifest (cli._fleet_subbatched). Refused only for an explicit
+       ``--resume``/``--save-state`` path, which names ONE snapshot and
+       has no cursor.
 
     The rollback drop is the one stage always available: it frees a
     transient copy, never a state leaf, so snapshots stay loadable (the
@@ -551,15 +555,17 @@ def downshift(exp, params, n_exp: int, budget: int, n_dev: int = 1,
     if est.peak_bytes > budget and n_exp > 1:
         k = est.max_lanes(budget)
         if 1 <= k < n_exp:
-            if resumable:
+            if resumable and not subbatch_resumable:
                 raise MemoryBudgetError(
                     estimated=est.peak_bytes, budget=budget,
                     planes=est.planes, peaks=est.peaks,
                     advice=est.advice(budget),
                     detail=" (sub-batched downshift does not compose with "
-                           "--ckpt/--resume: a sub-batched sweep has no "
-                           "single all-lane snapshot — drop the checkpoint "
-                           "flags or shrink the sweep)")
+                           "an explicit --resume/--save-state snapshot "
+                           "path — it names one all-lane state and "
+                           "carries no batch cursor; use --ckpt, whose "
+                           "lineage manifest records the sub-batch "
+                           "cursor, or shrink the sweep)")
             sub_batch = k
             actions.append({"action": "sub_batch", "lanes": k,
                             "batches": -(-n_exp // k)})
@@ -567,10 +573,11 @@ def downshift(exp, params, n_exp: int, budget: int, n_dev: int = 1,
             est = estimate(exp, params, n_exp=k, n_dev=n_dev)
     if est.peak_bytes > budget:
         detail = (" (the state-shape-preserving downshifts are exhausted: "
-                  "rollback dropped — ring shrink and sub-batching are "
-                  "unavailable under --ckpt/--resume because they change "
-                  "the snapshot shape; drop the checkpoint flags or "
-                  "shrink the config)" if resumable else
+                  "rollback dropped — ring shrink is unavailable under "
+                  "--ckpt/--resume because it changes the snapshot shape, "
+                  "and sub-batching needs --ckpt's batch cursor (not an "
+                  "explicit --resume/--save-state path); drop the "
+                  "checkpoint flags or shrink the config)" if resumable else
                   " (every --on-oom downshift is exhausted: rollback "
                   "dropped, ring floored, fleet at one lane — the base "
                   "state planes alone exceed the device)")

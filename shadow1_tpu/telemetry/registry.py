@@ -112,6 +112,16 @@ REC_RING_GAP = "ring_gap"
 REC_DIGEST = "digest"
 REC_FLEET_EXP = "fleet_exp"
 REC_FLEET_SUMMARY = "fleet_summary"
+# Fleet recovery plane (fleet/run.py, docs/OBSERVABILITY.md §"Fleet
+# recovery records"): ``fleet_retry`` = one record per discarded+replayed
+# fleet chunk (windows, caps grown, offending lanes per counter);
+# ``fleet_quarantine`` = one record per lane sliced out of the sweep
+# (exp/seed/reason/window/knob + the solo-resumable checkpoint path).
+# Chunk-level events, never per-window rows — like the retry counters,
+# they stay out of ring percentile math by being their own record types
+# (tools/heartbeat_report.py's fleet-recovery section reads them).
+REC_FLEET_RETRY = "fleet_retry"
+REC_FLEET_QUARANTINE = "fleet_quarantine"
 # Preemption plane (PR 7): ``resume`` = one record per lineage resume (which
 # generation, corrupt newer ones skipped); ``lineage`` = supervisor events
 # (watchdog_kill / preempted / corrupt_head / discard_all) — both on stderr,
@@ -134,6 +144,7 @@ REC_MEM = "mem"
 REC_WORK = "work"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
+                REC_FLEET_RETRY, REC_FLEET_QUARANTINE,
                 REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK)
 
 # The drop/overflow counter group: every way a modeled event or packet can

@@ -38,6 +38,18 @@ nothing ever touched. This probe proves it empirically:
    to skip, solo only) additionally pins the straight stream to the
    eager reference.
 
+**Fleet recovery matrix** (``--extra``): arbitrary CLI flags ride every
+launch, so the same kill matrix runs against the recovery plane — e.g.
+``--fleet --extra "--on-overflow retry"`` on an under-capped sweep
+(forced overflow: every relaunch must replay its transactional chunks to
+the same committed stream) or ``--fleet --extra "--on-overflow halt
+--on-lane-fail quarantine"`` on a sweep with one doomed lane (forced
+lane-halt: the sweep completes E-1/E; ``--expect-quarantine N`` asserts
+the straight run AND every trial quarantined exactly N lanes — the
+fleet_quarantine records are counted per trial, deduplicated by lane).
+Retry and quarantine are deterministic, so the straight reference stream
+already embodies them; a killed+relaunched trial must land bit-identical.
+
 Exit codes follow tools/paritytrace.py: 0 = all trials bit-identical,
 3 = divergence (the last stdout line is a JSON verdict either way; on a
 mismatch it prints the paritytrace invocation that localizes it).
@@ -90,6 +102,8 @@ def _collect_stream(stderr_paths, fleet: bool):
     conflict = None
     resumes: list[dict] = []
     lineage_events: list[dict] = []
+    quarantined: dict = {}  # lane gid -> fleet_quarantine record (deduped:
+    #                         a relaunch replays the chunk and re-emits it)
     for path in stderr_paths:
         try:
             with open(path) as f:
@@ -109,6 +123,8 @@ def _collect_stream(stderr_paths, fleet: bool):
                 resumes.append(rec)
             elif t == "lineage":
                 lineage_events.append(rec)
+            elif t == "fleet_quarantine":
+                quarantined[rec.get("exp")] = rec
             elif t == "ring" and DIGEST_FIELDS[0] in rec:
                 key = (rec.get("exp") if fleet else None, rec["window"])
                 val = tuple(rec[f] for f in DIGEST_FIELDS)
@@ -116,7 +132,7 @@ def _collect_stream(stderr_paths, fleet: bool):
                     conflict = {"window": key[1], "exp": key[0],
                                 "reason": "re-emitted row differs"}
                 stream[key] = val
-    return stream, conflict, resumes, lineage_events
+    return stream, conflict, resumes, lineage_events, quarantined
 
 
 def _npz_equal(a_path: str, b_path: str):
@@ -153,6 +169,16 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="run the matrix fleet-shaped (config needs a "
                          "sweep: section)")
+    ap.add_argument("--extra", default=None, metavar="FLAGS",
+                    help="extra CLI flags for every launch (one shell-"
+                         "quoted string), e.g. \"--on-overflow retry\" or "
+                         "\"--on-overflow halt --on-lane-fail quarantine\" "
+                         "— the fleet recovery trial matrix")
+    ap.add_argument("--expect-quarantine", type=int, default=None,
+                    metavar="N",
+                    help="assert the straight run and every trial "
+                         "quarantined exactly N lanes (forced-lane-halt "
+                         "matrix)")
     ap.add_argument("--no-oracle", action="store_true",
                     help="skip the cpu-oracle digest cross-check of the "
                          "straight run (solo only; fleet skips it anyway "
@@ -180,6 +206,10 @@ def main(argv=None) -> int:
             "--state-digest", "on"]
     if args.fleet:
         base.append("--fleet")
+    if args.extra:
+        import shlex
+
+        base.extend(shlex.split(args.extra))
 
     # ---- straight reference run -----------------------------------------
     ref_npz = os.path.join(work, "ref.npz")
@@ -194,14 +224,24 @@ def main(argv=None) -> int:
         print(json.dumps({"ok": False, "error": "straight run failed",
                           "rc": r.returncode, "stderr": ref_err}))
         return 1
-    ref_stream, conflict, _, _ = _collect_stream([ref_err], args.fleet)
+    ref_stream, conflict, _, _, ref_quar = _collect_stream([ref_err],
+                                                           args.fleet)
     assert conflict is None
     if not ref_stream:
         print(json.dumps({"ok": False,
                           "error": "straight run emitted no digest rows"}))
         return 1
     say(f"[chaosprobe] straight run: {len(ref_stream)} digest rows, "
-        f"{straight_wall:.1f}s wall")
+        f"{straight_wall:.1f}s wall"
+        + (f", {len(ref_quar)} lane(s) quarantined" if ref_quar else ""))
+    if args.expect_quarantine is not None \
+            and len(ref_quar) != args.expect_quarantine:
+        print(json.dumps({
+            "ok": False,
+            "error": f"straight run quarantined {len(ref_quar)} lane(s), "
+                     f"expected {args.expect_quarantine}",
+            "quarantined": sorted(ref_quar)}))
+        return 1
 
     # ---- cpu-oracle cross-check of the straight stream ------------------
     oracle_checked = False
@@ -347,7 +387,8 @@ def main(argv=None) -> int:
         total_preempted += preempted
         if trial_err is None and not os.path.exists(fin):
             trial_err = "final state was never written"
-        stream, conflict, resumes, _events = _collect_stream(errs, args.fleet)
+        stream, conflict, resumes, _events, quar = _collect_stream(
+            errs, args.fleet)
         fallbacks = sum(1 for r in resumes if r.get("fallback_skipped"))
         # mid_write leaves no corrupt file to skip — the fallback shows as
         # a resume from a generation older than the torn head's seq.
@@ -373,6 +414,12 @@ def main(argv=None) -> int:
                         mismatch = {"exp": key[0], "window": key[1],
                                     "reason": "digest row differs"}
                         break
+            if mismatch is None and sorted(quar) != sorted(ref_quar):
+                # Quarantine is deterministic: a killed+relaunched trial
+                # must slice out exactly the lanes the straight run did.
+                mismatch = {"reason": f"quarantined lanes differ: trial "
+                                      f"{sorted(quar)} vs straight "
+                                      f"{sorted(ref_quar)}"}
             if mismatch is None:
                 why = _npz_equal(ref_npz, fin)
                 if why:
@@ -380,6 +427,7 @@ def main(argv=None) -> int:
         v = {"trial": ti, "kind": kind, "launches": launches,
              "killed": killed, "preempted_exits": preempted,
              "lineage_fallbacks": fallbacks,
+             "quarantined": sorted(quar),
              "ok": trial_err is None and mismatch is None}
         if trial_err:
             v["error"] = trial_err
@@ -398,6 +446,8 @@ def main(argv=None) -> int:
         "trials": len(verdicts),
         "windows": args.windows,
         "fleet": bool(args.fleet),
+        "extra": args.extra,
+        "quarantined": sorted(ref_quar),
         "oracle_checked": oracle_checked,
         "digest_rows": len(ref_stream),
         "launches": total_launches,

@@ -2,6 +2,8 @@
 
     python -m shadow1_tpu.tools.fleetprobe sweep.yaml [--sides tpu,cpu]
         [--windows N] [--exps 0,2] [--json-only]
+    python -m shadow1_tpu.tools.fleetprobe sweep.yaml --retry
+        [--sides tpu,cpu] [--windows N] [--chunk C]
 
 Expands the config's ``sweep:`` section, runs the WHOLE fleet as one
 vmapped program with the determinism flight recorder on, then runs each
@@ -10,6 +12,14 @@ engine, ``cpu`` = the eager oracle) and asserts the per-window digest
 streams are bit-identical per experiment: lane e of the fleet must be
 indistinguishable from running experiment e by itself
 (docs/SEMANTICS.md §"Fleet contract").
+
+``--retry`` proves the FLEET TRANSACTIONAL RETRY contract instead
+(docs/SEMANTICS.md §"Fleet recovery contract", mirroring the PR 5 solo
+proof): the config must be deliberately under-capped — the sweep runs
+under ``--on-overflow retry`` (chunks discarded, the fleet-uniform cap
+grown, replayed), must actually retry at least once, and every lane's
+committed digest stream must bit-match (a) the straight fleet run at the
+final grown caps and (b, ``cpu`` side) the eager oracle at those caps.
 
 Exit codes follow tools/paritytrace.py: 0 = parity, 3 = divergence (the
 last stdout line is a JSON verdict either way). On a mismatch the verdict
@@ -52,6 +62,82 @@ def _first_mismatch(fleet: dict, solo: dict) -> dict | None:
     return None
 
 
+def _retry_probe(plan, params, windows: int, chunk: int, sides, say) -> dict:
+    """The fleet-retry bit-exactness proof: under-capped fleet + retry ==
+    straight fleet at the final grown caps, per lane, plus the cpu-oracle
+    side at those caps."""
+    import dataclasses
+
+    from shadow1_tpu.core.digest import SUBSYSTEMS
+    from shadow1_tpu.fleet.engine import FleetEngine, slice_experiment
+    from shadow1_tpu.fleet.run import run_fleet
+
+    p_retry = dataclasses.replace(params, on_overflow="retry")
+    eng = FleetEngine(plan.exps, p_retry, plan.max_rounds)
+    say(f"[fleetprobe] retry fleet: {eng.n_exp} experiments x {windows} "
+        f"windows at ev_cap={p_retry.ev_cap}")
+    st, hb = run_fleet(eng, n_windows=windows, every_windows=chunk,
+                       stream=False)
+    guard = hb.guard
+    verdict = {
+        "mode": "retry",
+        "experiments": eng.n_exp,
+        "windows": windows,
+        "chunk_retries": guard.chunk_retries,
+        "retry_windows_rerun": guard.retry_windows_rerun,
+        "final_caps": guard.final_caps,
+        "sides": list(sides),
+        "mismatches": [],
+    }
+    if guard.chunk_retries == 0:
+        verdict.update(ok=False, error="config never overflowed — the "
+                                       "retry proof needs an under-capped "
+                                       "sweep (shrink engine.ev_cap)")
+        return verdict
+    retry_streams = [
+        _ring_digest_stream(slice_experiment(st, e), eng.window)
+        for e in range(eng.n_exp)
+    ]
+    p_big = dataclasses.replace(
+        params, on_overflow="drop",
+        ev_cap=guard.final_caps["ev_cap"],
+        outbox_cap=guard.final_caps["outbox_cap"])
+    if "tpu" in sides:
+        big = FleetEngine(plan.exps, p_big, plan.max_rounds)
+        st_big = big.run(n_windows=windows)
+        for e in range(eng.n_exp):
+            solo = _ring_digest_stream(slice_experiment(st_big, e),
+                                       big.window)
+            mm = _first_mismatch(retry_streams[e], solo)
+            if mm is None:
+                say(f"[fleetprobe] exp {e} retry vs big-cap fleet: "
+                    f"{len(solo)} windows bit-identical")
+            else:
+                verdict["mismatches"].append(
+                    {"exp": e, "side": "tpu", **mm})
+    if "cpu" in sides:
+        from shadow1_tpu.core.digest import SUBSYSTEMS
+        from shadow1_tpu.cpu_engine import CpuEngine
+
+        for e, exp in enumerate(plan.exps):
+            cpu = CpuEngine(exp, dataclasses.replace(
+                p_big, max_rounds=plan.max_rounds[e]))
+            cpu.run(n_windows=windows)
+            orc = {r["window"]: tuple(r[f"dg_{s}"] for s in SUBSYSTEMS)
+                   for r in cpu.digest_rows}
+            sub = {w: retry_streams[e][w] for w in orc
+                   if w in retry_streams[e]}
+            if orc == sub and len(orc) == len(retry_streams[e]):
+                say(f"[fleetprobe] exp {e} retry vs cpu oracle at final "
+                    f"caps: {len(orc)} windows bit-identical")
+            else:
+                mm = _first_mismatch(retry_streams[e], orc)
+                verdict["mismatches"].append(
+                    {"exp": e, "side": "cpu", **(mm or {})})
+    verdict["ok"] = not verdict["mismatches"]
+    return verdict
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.fleetprobe")
     ap.add_argument("config", help="YAML experiment file with a sweep: "
@@ -66,6 +152,15 @@ def main(argv=None) -> int:
     ap.add_argument("--exps", default=None,
                     help="comma list of experiment indices to solo-check "
                          "(default: all)")
+    ap.add_argument("--retry", action="store_true",
+                    help="fleet transactional-retry proof: run the "
+                         "(deliberately under-capped) sweep under "
+                         "--on-overflow retry and assert per-lane digest "
+                         "parity with the straight big-cap fleet run "
+                         "(tpu side) and the eager oracle (cpu side)")
+    ap.add_argument("--chunk", type=int, default=5,
+                    help="chunk (windows) for the --retry transactional "
+                         "boundaries")
     ap.add_argument("--json-only", action="store_true",
                     help="suppress progress lines; print only the verdict")
     args = ap.parse_args(argv)
@@ -102,6 +197,14 @@ def main(argv=None) -> int:
         windows = min(n_total, 200)
     params = dataclasses.replace(plan.params, state_digest=1,
                                  metrics_ring=max(windows, 1))
+
+    if args.retry:
+        verdict = _retry_probe(plan, params, windows, args.chunk, sides,
+                               say)
+        print(json.dumps(verdict))
+        if verdict.get("error"):
+            return 2
+        return 0 if verdict["ok"] else EXIT_DIVERGED
 
     try:
         fleet = FleetEngine(plan.exps, params, plan.max_rounds)

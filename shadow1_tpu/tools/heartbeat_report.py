@@ -22,6 +22,9 @@ import sys
 from shadow1_tpu.telemetry.registry import (
     DROP_SPECS,
     REC_FLEET_EXP,
+    REC_FLEET_QUARANTINE,
+    REC_FLEET_RETRY,
+    REC_FLEET_SUMMARY,
     REC_HEARTBEAT,
     REC_LINEAGE,
     REC_MEM,
@@ -174,7 +177,10 @@ def summarize(recs: list[dict], out=None) -> dict:
     rings = [r for r in recs if r.get("type") == REC_RING]
     gaps = [r for r in recs if r.get("type") == REC_RING_GAP]
     works = [r for r in recs if r.get("type") == REC_WORK]
-    fleet_exp = [r for r in recs if r.get("type") == REC_FLEET_EXP]
+    # Early-finalized lanes' fleet_exp records reach stdout AND the stderr
+    # log stream — a combined capture holds them twice: dedupe by lane.
+    fleet_exp = list({r.get("exp"): r for r in recs
+                      if r.get("type") == REC_FLEET_EXP}.values())
     summary: dict = {
         "heartbeats": len(hb),
         "tracker_records": len(tr),
@@ -182,17 +188,83 @@ def summarize(recs: list[dict], out=None) -> dict:
     }
     if fleet_exp:
         # Fleet final records: one row per experiment (events, drops,
-        # restarts) — the sweep's result table.
+        # restarts) — the sweep's result table. Early-finished lanes
+        # (--lane-finalize) are flagged inline with the window count they
+        # actually ran.
         summary["fleet_experiments"] = len(fleet_exp)
         print("== fleet experiments ==", file=out)
         for r in sorted(fleet_exp, key=lambda r: r.get("exp", 0)):
             m = r.get("metrics", {})
             drops = r.get("drops", {})
+            early = (f"  [finished early at window {r.get('windows')}]"
+                     if r.get("finished_early") else "")
             print(f"  exp {r.get('exp')}: seed {r.get('seed')}  "
                   f"events {m.get('events')}  "
                   f"delivered {m.get('pkts_delivered')}  "
                   f"drops {drops.get('total', 0)}  "
-                  f"restarts {m.get('host_restarts', 0)}", file=out)
+                  f"restarts {m.get('host_restarts', 0)}{early}",
+                  file=out)
+    # Fleet recovery plane (fleet/run.py): per-retry and per-quarantine
+    # events plus the summary ledger. These are their OWN record types —
+    # chunk-level events, not per-window rows — so like the digest/retry
+    # columns they never enter the ring percentile math below.
+    # Quarantine records reach BOTH stdout (the CLI result stream) and
+    # stderr (the log stream) — a combined capture holds each twice, and a
+    # killed+relaunched run re-emits them on replay: dedupe by lane.
+    fq = list({r.get("exp"): r for r in recs
+               if r.get("type") == REC_FLEET_QUARANTINE}.values())
+    fr = [r for r in recs if r.get("type") == REC_FLEET_RETRY]
+    early = [r for r in fleet_exp if r.get("finished_early")]
+    fsum = [r for r in recs if r.get("type") == REC_FLEET_SUMMARY]
+    if fq or fr or early:
+        rec_sum: dict = {"chunk_retries": sum(1 for r in fr
+                                              if not r.get("discarded")),
+                         "quarantined": len(fq),
+                         "finished_early": len(early)}
+        # Per-lane retry counts: how many chunks each experiment's
+        # overflow tainted (sweep-global ids from the fleet_retry
+        # records). One count per RECORD even when a lane overflowed
+        # several counters in the same chunk; grows rolled back by a
+        # quarantine (``discarded``) are audit-only and stay out.
+        per_lane: dict = {}
+        for r in fr:
+            if r.get("discarded"):
+                continue
+            gids = {g for gl in (r.get("lanes") or {}).values()
+                    for g in gl}
+            for g in gids:
+                per_lane[g] = per_lane.get(g, 0) + 1
+        if per_lane:
+            rec_sum["retries_by_exp"] = per_lane
+        summary["fleet_recovery"] = rec_sum
+        print("== fleet recovery ==", file=out)
+        print(f"  chunk retries: {rec_sum['chunk_retries']}"
+              f"  quarantined lanes: {len(fq)}"
+              f"  early-finished lanes: {len(early)}", file=out)
+        for g, n in sorted(per_lane.items()):
+            print(f"  exp {g}: tainted {n} chunk(s)", file=out)
+        for r in fr:
+            grown = {k: v for k, v in r.items()
+                     if k in ("ev_cap", "outbox_cap", "x2x_cap")}
+            tag = ("  [discarded: rolled back by a quarantine]"
+                   if r.get("discarded") else "")
+            print(f"  retry {r.get('retry')}: windows {r.get('windows')}"
+                  f"  grown {grown}{tag}", file=out)
+        for r in fq:
+            print(f"  quarantine: exp {r.get('exp')} (seed "
+                  f"{r.get('seed')}) — {r.get('reason')}"
+                  + (f" on {r.get('knob')}" if r.get("knob") else "")
+                  + f" at window {r.get('window')}; solo-resumable ckpt "
+                    f"{r.get('ckpt')}", file=out)
+        for r in early:
+            print(f"  finished early: exp {r.get('exp')} at window "
+                  f"{r.get('windows')} of {r.get('windows_configured')}",
+                  file=out)
+        if fsum and fsum[-1].get("quarantined"):
+            print(f"  sweep completed "
+                  f"{fsum[-1].get('experiments')}+"
+                  f"{len(fsum[-1]['quarantined'])}q/"
+                  f"{fsum[-1].get('experiments_initial')}", file=out)
     if hb:
         eps = [r["events_per_sec"] for r in hb if r.get("events_per_sec")]
         spw = [r["sim_per_wall"] for r in hb if r.get("sim_per_wall")]

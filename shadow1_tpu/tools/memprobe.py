@@ -25,8 +25,12 @@ Three modes (combinable; default ``--audit``):
   (default: ceil(E/2)), and assert every lane's per-window digest stream
   AND parity metrics are bit-identical between the two — lanes are
   independent, so sub-batching is digest-neutral (the property
-  ``--on-oom downshift`` relies on). Exit 3 on divergence, paritytrace
-  pointer in the verdict.
+  ``--on-oom downshift`` relies on). Each sub-batch is additionally run
+  THROUGH a mid-batch checkpoint cycle (snapshot at the halfway chunk,
+  reload into a fresh engine, continue) — the per-sub-batch
+  checkpoint/resume path that lets ``--on-oom downshift`` compose with
+  ``--ckpt`` (cli._fleet_subbatched) must be digest-neutral too. Exit 3
+  on divergence, paritytrace pointer in the verdict.
 
 The last stdout line is always one JSON verdict.
 """
@@ -160,12 +164,17 @@ def _lane_streams(eng, st) -> dict[int, dict[int, tuple]]:
 
 def subbatch_parity(path: str, sub: int | None, windows: int | None,
                     say) -> dict:
-    """Full-E fleet vs sequential sub-batches: per-lane digest streams and
-    parity counters must be bit-identical (the downshift contract)."""
+    """Full-E fleet vs sequential sub-batches (each cycled through a
+    mid-batch checkpoint save/reload): per-lane digest streams and parity
+    counters must be bit-identical (the downshift + per-batch-ckpt
+    contract)."""
     import dataclasses
+    import os
+    import tempfile
 
     import jax
 
+    from shadow1_tpu.ckpt import load_state, save_state
     from shadow1_tpu.fleet.engine import FleetEngine, fleet_metrics_per_exp
     from shadow1_tpu.fleet.expand import load_sweep
 
@@ -192,18 +201,34 @@ def subbatch_parity(path: str, sub: int | None, windows: int | None,
     counters = _parity_counter_names()
     sub_streams: dict[int, dict[int, tuple]] = {}
     sub_metrics: dict[int, dict] = {}
+    half = n_windows // 2
+    ck_dir = tempfile.TemporaryDirectory(prefix="memprobe_")
+    ck = os.path.join(ck_dir.name, "batch.npz")
     for i in range(0, E, sub):
-        say(f"sub-batch lanes [{i}, {min(i + sub, E)})")
+        say(f"sub-batch lanes [{i}, {min(i + sub, E)}) "
+            f"(ckpt cycle at window {half})")
         eng_b = FleetEngine(plan.exps[i:i + sub], params,
                             plan.max_rounds[i:i + sub])
         eng_b.exp_base = i
-        st_b = eng_b.run(n_windows=n_windows)
+        if half > 0:
+            # Mid-batch checkpoint cycle: snapshot, reload into a FRESH
+            # engine, continue — the per-sub-batch resume path of
+            # --on-oom downshift + --ckpt must be digest-neutral.
+            save_state(eng_b.run(n_windows=half), ck)
+            eng_b = FleetEngine(plan.exps[i:i + sub], params,
+                                plan.max_rounds[i:i + sub])
+            eng_b.exp_base = i
+            st_b = eng_b.run(load_state(eng_b.init_state(), ck),
+                             n_windows=n_windows - half)
+        else:
+            st_b = eng_b.run(n_windows=n_windows)
         jax.block_until_ready(st_b)
         sub_streams.update(_lane_streams(eng_b, st_b))
         for j, m in enumerate(fleet_metrics_per_exp(st_b)):
             sub_metrics[i + j] = m
+    ck_dir.cleanup()
     verdict = {"config": path, "experiments": E, "lanes_per_batch": sub,
-               "windows": n_windows,
+               "windows": n_windows, "ckpt_cycled": half > 0,
                "streams_compared": len(full_streams)}
     for e in range(E):
         f, s = full_streams.get(e, {}), sub_streams.get(e, {})

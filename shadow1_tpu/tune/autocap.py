@@ -38,6 +38,23 @@ import dataclasses
 from shadow1_tpu.tune.ladder import HEADROOM, next_step, quantize_cap
 
 
+def _peak(x) -> int:
+    """A gauge as one fleet-global int: max over the lane axis (identity on
+    solo 0-d gauges). Caps are fleet-uniform, so the controller sizes for
+    the BUSIEST lane — any smaller cap would overflow it."""
+    import numpy as np
+
+    return int(np.asarray(x).max())
+
+
+def _total(x) -> int:
+    """A counter as one fleet-global int: sum over the lane axis (identity
+    on solo 0-d counters) — the psum idiom the sharded engine uses."""
+    import numpy as np
+
+    return int(np.asarray(x).sum())
+
+
 @dataclasses.dataclass(frozen=True)
 class CapPolicy:
     grow_frac: float = 0.75    # grow when high_water > grow_frac * cap
@@ -63,11 +80,13 @@ class CapController:
         # A RESUMED state carries its pre-snapshot history in the cumulative
         # counters; baseline from it (``initial_state``) so a respawn does
         # not mistake old losses for a fresh lossy chunk and force a
-        # spurious grow + re-jit on every restart.
+        # spurious grow + re-jit on every restart. Counters sum over the
+        # lane axis on a FleetEngine state (_total) — caps are
+        # fleet-uniform, so ANY lane's loss is the fleet's loss.
         self._overflow_seen = {
-            "ev_cap": (int(initial_state.metrics.ev_overflow)
+            "ev_cap": (_total(initial_state.metrics.ev_overflow)
                        if initial_state is not None else 0),
-            "outbox_cap": (int(initial_state.metrics.ob_overflow)
+            "outbox_cap": (_total(initial_state.metrics.ob_overflow)
                            if initial_state is not None else 0),
         }
         # Lossless floor: once a cap has overflowed, shrinking back to it
@@ -152,16 +171,19 @@ class CapController:
 
         params = engine.params
         # The gauges ride the metrics fetch the chunk drain already paid.
-        ev_hw = int(st.metrics.ev_max_fill)
-        ob_hw = int(st.metrics.ob_max_fill)
+        # On a FleetEngine state they are [E] vectors: the controller is fed
+        # the FLEET-GLOBAL view — max fill across lanes (caps are uniform,
+        # so the busiest lane sets the floor), summed overflow counters.
+        ev_hw = _peak(st.metrics.ev_max_fill)
+        ob_hw = _peak(st.metrics.ob_max_fill)
         new_ev = self._decide("ev_cap", ev_hw, params.ev_cap)
-        new_ev = self._overflow_grow("ev_cap", int(st.metrics.ev_overflow),
+        new_ev = self._overflow_grow("ev_cap", _total(st.metrics.ev_overflow),
                                      params.ev_cap, new_ev)
         new_ob = (self._decide("outbox_cap", ob_hw, params.outbox_cap)
                   if self.policy.tune_outbox else params.outbox_cap)
         if self.policy.tune_outbox:
             new_ob = self._overflow_grow("outbox_cap",
-                                         int(st.metrics.ob_overflow),
+                                         _total(st.metrics.ob_overflow),
                                          params.outbox_cap, new_ob)
         if (new_ev, new_ob) == (params.ev_cap, params.outbox_cap):
             return engine, st
@@ -172,7 +194,7 @@ class CapController:
         new_params = _dc.replace(params, ev_cap=new_ev, outbox_cap=new_ob)
         new_engine = self._engine_for(new_params)
         rec = {
-            "windows_done": int(st.metrics.windows),
+            "windows_done": _peak(st.metrics.windows),
             "ev_cap": [params.ev_cap, new_ev],
             "outbox_cap": [params.outbox_cap, new_ob],
             "ev_max_fill": ev_hw,

@@ -2,7 +2,11 @@
 
 Runs on HOST (plain numpy) at chunk boundaries or checkpoint load — never
 inside the jitted window path — and re-shapes the ``[C, H]`` event-buffer
-planes / ``[P, H]`` outbox planes to a new static capacity:
+planes / ``[P, H]`` outbox planes to a new static capacity. Every operation
+addresses the slot axis as ``axis=-2``, so planes with leading axes migrate
+identically: a fleet state's ``[E, C, H]`` planes (fleet transactional
+retry / fleet ``--auto-caps``) go through the exact same code path as a
+solo ``[C, H]`` state — per lane, the migration is the solo migration:
 
 * **grow**: append free-slot sentinel rows (exactly the ``evbuf_init`` /
   ``outbox_init`` fill values), occupied slots untouched;
@@ -49,13 +53,23 @@ def _pad_rows(x: np.ndarray, n: int, fill) -> np.ndarray:
     return np.concatenate([x, np.full(pad_shape, fill, x.dtype)], axis=-2)
 
 
+def _expand_order(order: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Broadcast a slot-permutation ``order`` (shape [*lead, C, H]) onto a
+    plane ``x`` (shape [*lead, *extra, C, H]) whose extra axes (e.g. the
+    payload NP axis) sit between the shared leading axes and the slot axis:
+    insert singleton axes there, then broadcast."""
+    k = x.ndim - order.ndim
+    o = order.reshape(order.shape[:-2] + (1,) * k + order.shape[-2:])
+    return np.broadcast_to(o, x.shape)
+
+
 def resize_evbuf(buf, new_cap: int):
     """EventBuf (numpy leaves) at cap C → the same queue contents at
     ``new_cap``. Returns a new EventBuf; [H]-vector/scalar leaves
     (self_ctr, epoch, n_elig, u32) are capacity-independent and carried
-    as-is."""
+    as-is. Leading axes ([E, C, H] fleet planes) migrate per lane."""
     kind = np.asarray(buf.kind)
-    cap, _h = kind.shape
+    cap = kind.shape[-2]
     new_cap = int(new_cap)
     if new_cap == cap:
         return buf
@@ -64,7 +78,7 @@ def resize_evbuf(buf, new_cap: int):
                         "kind", "p")}
     if new_cap < cap:
         occupied = planes["kind"] != K_NONE
-        n_occ = occupied.sum(axis=0).max()
+        n_occ = occupied.sum(axis=-2).max()
         if n_occ > new_cap:
             raise ValueError(
                 f"cannot shrink ev_cap {cap} -> {new_cap}: a host holds "
@@ -72,9 +86,9 @@ def resize_evbuf(buf, new_cap: int):
             )
         # Stable partition: occupied slots first, original slot order kept
         # (argsort of the free flag is stable ⇒ ties keep slot order).
-        order = np.argsort(~occupied, axis=0, kind="stable")
+        order = np.argsort(~occupied, axis=-2, kind="stable")
         for f, x in planes.items():
-            o = order if x.ndim == 2 else np.broadcast_to(order, x.shape)
+            o = _expand_order(order, x)
             planes[f] = np.take_along_axis(x, o, axis=-2)[..., :new_cap, :]
     else:
         thi, tlo = _tb_split_np(_I64_MAX)
@@ -95,7 +109,7 @@ def resize_outbox(ob, new_cap: int):
     and shrink truncates; slots ≥ cnt are never read, so stale content
     beyond the truncation point is immaterial."""
     dst = np.asarray(ob.dst)
-    cap, _h = dst.shape
+    cap = dst.shape[-2]
     new_cap = int(new_cap)
     if new_cap == cap:
         return ob

@@ -75,12 +75,16 @@ class CapacityExceededError(RuntimeError):
 
     def __init__(self, knob: str, counter: str, cap: int, overflow: int,
                  window_range: tuple[int, int], recommended: int | None = None,
-                 detail: str = "", remedy: str | None = None):
+                 detail: str = "", remedy: str | None = None,
+                 lanes: list[int] | None = None):
         self.knob = knob
         self.counter = counter
         self.cap = int(cap)
         self.overflow = int(overflow)
         self.window_range = (int(window_range[0]), int(window_range[1]))
+        # Fleet attribution: LOCAL lane indices whose counter overflowed
+        # (None on solo engines) — what --on-lane-fail quarantine slices.
+        self.lanes = list(lanes) if lanes is not None else None
         if recommended is None:
             from shadow1_tpu.tune.ladder import next_step
 
@@ -215,8 +219,15 @@ class OverflowGuard:
         self._seen = self._counters(st)
 
     @staticmethod
-    def _counters(st) -> dict[str, int]:
-        return {c: int(getattr(st.metrics, c)) for c in OVERFLOW_KNOBS}
+    def _counters(st) -> dict:
+        """Cumulative overflow counters as numpy arrays — 0-d on solo
+        engines, [E] on a FleetEngine state, so one guard serves both: the
+        fleet's psum-equivalent is the lane sum, and per-lane deltas stay
+        available for attribution (fresh_by_lane)."""
+        import numpy as np
+
+        return {c: np.asarray(getattr(st.metrics, c)).astype(np.int64)
+                for c in OVERFLOW_KNOBS}
 
     @staticmethod
     def run_guarded(engine, st, n_windows: int):
@@ -231,8 +242,28 @@ class OverflowGuard:
 
     def _fresh(self, st) -> dict[str, int]:
         cur = self._counters(st)
-        return {c: v - self._seen[c] for c, v in cur.items()
-                if v - self._seen[c] > 0}
+        out = {}
+        for c, v in cur.items():
+            d = int((v - self._seen[c]).sum())
+            if d > 0:
+                out[c] = d
+        return out
+
+    def fresh_by_lane(self, st) -> dict[str, list[int]]:
+        """Fleet attribution: LOCAL lane indices with fresh overflow per
+        counter since the last bind/commit ({} on solo engines — 0-d
+        counters carry no lane axis)."""
+        import numpy as np
+
+        cur = self._counters(st)
+        out: dict[str, list[int]] = {}
+        for c, v in cur.items():
+            if v.ndim == 0:
+                continue
+            lanes = np.nonzero(v - self._seen[c] > 0)[0]
+            if lanes.size:
+                out[c] = [int(e) for e in lanes]
+        return out
 
     # -- the transaction ---------------------------------------------------
     def commit(self, engine, st0, st, done: int, step: int):
@@ -244,7 +275,11 @@ class OverflowGuard:
         fresh = self._fresh(st)
         attempts = 0
         while fresh:
-            w0 = int(st0.win_start) // engine.window
+            import numpy as np
+
+            # max over lanes == the scalar on solo engines; fleet lanes
+            # advance in lockstep, so any lane's clock is the chunk's.
+            w0 = int(np.asarray(st0.win_start).max()) // engine.window
             if self.mode == "halt":
                 raise self._error(engine, fresh, w0, w0 + step, st)
             attempts += 1
@@ -257,7 +292,8 @@ class OverflowGuard:
                             f"tools/paritytrace.py"))
             self.chunk_retries += 1
             self.retry_windows_rerun += step
-            engine, st0 = self._grow(engine, st0, fresh, w0, w0 + step, st)
+            engine, st0 = self._grow(engine, st0, fresh, w0, w0 + step, st,
+                                     lanes=self.fresh_by_lane(st) or None)
             st = self.run_guarded(engine, st0, step)
             fresh = self._fresh(st)
         self._seen = self._counters(st)
@@ -280,7 +316,7 @@ class OverflowGuard:
             eng = self._engines[key] = self._make_engine(params)
         return eng
 
-    def _grow(self, engine, st0, fresh, w0, w1, st_tainted):
+    def _grow(self, engine, st0, fresh, w0, w1, st_tainted, lanes=None):
         import dataclasses
 
         from shadow1_tpu.tune.ladder import next_step
@@ -288,6 +324,10 @@ class OverflowGuard:
         params = engine.params
         repl: dict[str, int] = {}
         rec: dict = {"windows": [w0, w1], "retry": self.chunk_retries}
+        if lanes:
+            # Fleet retry audit: which lanes' counters tainted this chunk
+            # (heartbeat_report's per-lane retry table reads these).
+            rec["lanes"] = lanes
         for ctr, knob in OVERFLOW_KNOBS.items():
             if ctr not in fresh:
                 continue
@@ -334,17 +374,24 @@ class OverflowGuard:
         return engine, st0
 
     def _error(self, engine, fresh, w0, w1, st, detail=""):
+        import numpy as np
+
         from shadow1_tpu.tune.ladder import next_step, recommend_cap
 
         counter = max(fresh, key=lambda c: fresh[c])
         knob = OVERFLOW_KNOBS[counter]
         cap = (getattr(engine, "_x2x_cap", 0) if knob == "x2x_cap"
                else getattr(engine.params, knob))
-        peak = int(getattr(st.metrics, _KNOB_GAUGE[knob], 0))
+        # max over lanes == the scalar on solo engines (gauges are maxes).
+        peak = int(np.asarray(getattr(st.metrics, _KNOB_GAUGE[knob], 0)).max())
         rec = max(next_step(cap), recommend_cap(peak) if peak else 0)
+        lanes = self.fresh_by_lane(st).get(counter) if self._seen else None
+        if lanes:
+            detail = f" (fleet lane(s) {lanes})" + detail
         return CapacityExceededError(
             knob=knob, counter=counter, cap=cap, overflow=fresh[counter],
-            window_range=(w0, w1), recommended=rec, detail=detail)
+            window_range=(w0, w1), recommended=rec, detail=detail,
+            lanes=lanes)
 
     # -- reporting ---------------------------------------------------------
     @property

@@ -292,16 +292,18 @@ def test_fleet_slice_resumes_solo(fleet_run, tmp_path):
 # rejections / boundary policies
 # ---------------------------------------------------------------------------
 
-def test_fleet_rejects_auto_caps_and_retry():
+def test_fleet_accepts_auto_caps_and_retry():
+    """Rejection-lift regression (PR 13): --auto-caps and --on-overflow
+    retry were structured kind="mode" rejections through PR 12 — both now
+    CONSTRUCT (the recovery semantics are proven in
+    tests/test_fleet_recover.py)."""
     plan = expand_sweep(sweep_doc())
-    with pytest.raises(FleetConfigError) as ei:
-        FleetEngine(plan.exps,
-                    dataclasses.replace(plan.params, auto_caps=1))
-    assert ei.value.kind == "mode" and ei.value.knob == "auto_caps"
-    with pytest.raises(FleetConfigError) as ei:
-        FleetEngine(plan.exps,
-                    dataclasses.replace(plan.params, on_overflow="retry"))
-    assert ei.value.kind == "mode" and ei.value.knob == "on_overflow"
+    eng = FleetEngine(plan.exps,
+                      dataclasses.replace(plan.params, auto_caps=1))
+    assert eng.params.auto_caps == 1
+    eng = FleetEngine(plan.exps,
+                      dataclasses.replace(plan.params, on_overflow="retry"))
+    assert eng.params.on_overflow == "retry"
 
 
 def test_fleet_halt_names_the_overflowing_experiment():
@@ -516,10 +518,13 @@ def test_cli_fleet_structured_rejections(tmp_path):
     assert rc == EXIT_CONFIG
     err = json.loads(lines[-1])
     assert err["error"] == "fleet_config" and err["kind"] == "mode"
-    rc, lines = run("--auto-caps")
-    assert rc == EXIT_CONFIG and json.loads(lines[-1])["knob"] == "auto_caps"
-    rc, lines = run("--on-overflow", "retry")
-    assert rc == EXIT_CONFIG and json.loads(lines[-1])["knob"] == "on_overflow"
+    # Rejection-lift regression (PR 13): --auto-caps / --on-overflow retry
+    # under --fleet no longer exit with the old kind="mode" records — the
+    # sweep runs (recovery semantics proven in tests/test_fleet_recover.py).
+    for flags in (("--auto-caps",), ("--on-overflow", "retry")):
+        rc, lines = run(*flags, "--windows", "4")
+        assert rc == 0, (flags, lines[-1:])
+        assert json.loads(lines[-1])["type"] == "fleet_summary", flags
     # No sweep: section -> schema-kind rejection.
     solo = tmp_path / "solo.yaml"
     solo.write_text(cfg.read_text().replace("sweep: {seeds: [7, 8, 9]}\n",

@@ -209,7 +209,12 @@ def test_downshift_keeps_ring_when_digest_on():
     assert actions[0]["action"] == "shrink_ring"
 
 
-def test_downshift_refuses_subbatch_with_ckpt():
+def test_downshift_subbatch_resume_gating():
+    """Sub-batching refuses an explicit --resume/--save-state snapshot
+    path (no batch cursor), but composes with --ckpt — the CLI sets
+    ``subbatch_resumable`` for plain --ckpt runs and each batch then
+    checkpoints its own state (the PR 13 lifted refusal;
+    tests/test_fleet_recover.py proves the round trip end to end)."""
     exp = phold_exp()
     params = EngineParams(ev_cap=32, outbox_cap=16)
     e1 = mem.estimate(exp, params, n_exp=1)
@@ -218,6 +223,11 @@ def test_downshift_refuses_subbatch_with_ckpt():
     with pytest.raises(mem.MemoryBudgetError) as ei:
         mem.downshift(exp, params, 4, budget, resumable=True)
     assert "--ckpt" in str(ei.value)
+    p2, sub, actions = mem.downshift(exp, params, 4, budget,
+                                     resumable=True,
+                                     subbatch_resumable=True)
+    assert sub is not None and 1 <= sub < 4
+    assert actions[-1]["action"] == "sub_batch"
 
 
 def test_downshift_skips_ring_shrink_when_resumable():
