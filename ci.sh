@@ -26,7 +26,14 @@
 #                      cpu+tpu), a 3-trial chaosprobe fleet matrix
 #                      (kill-anywhere under forced overflow retry +
 #                      forced-lane-halt quarantine), and the quarantined
-#                      lane's checkpoint resuming solo bit-identically
+#                      lane's checkpoint resuming solo bit-identically;
+#                      plus the serve-plane smokes: a real daemon round
+#                      trip (sequential same-shape jobs -> engine-cache
+#                      hit with no recompile, over-budget submission
+#                      rejected pre-compile with advice, served digest
+#                      streams bit-matching solo CLI runs, SIGTERM drain)
+#                      and a kill-during-submit chaos pair (no torn spool
+#                      records, restart completes bit-identically)
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -37,7 +44,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py tests/test_serve.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -344,6 +351,42 @@ sb = d["subbatch"]
 assert sb["experiments"] == 4 and sb["streams_compared"] == 4, sb
 print("memprobe: 4-lane sweep sub-batched (3+1) bit-identical per lane,",
       sb["windows"], "windows")
+'
+    echo "== serve-plane smoke (daemon round-trip: cache hit + admission + digest parity) =="
+    # The serve acceptance gates (ISSUE 14 / docs/SEMANTICS.md §"Serving
+    # contract"), all in one probe: spawn a real daemon on CPU, submit two
+    # same-shape jobs SEQUENTIALLY (second batch must be an engine-cache
+    # HIT — no re-trace, no recompile), submit one over-budget job (must
+    # be rejected pre-compile with the memory_budget advice record and
+    # EXIT_MEMORY while the others run), bit-compare both completed jobs'
+    # digest streams against solo CLI runs, and SIGTERM-drain the daemon
+    # (EXIT_SERVE_SHUTDOWN).
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.serveprobe \
+        configs/serve_phold.yaml --seeds 5,6 \
+        --overbudget configs/mem_overbudget.yaml --mem-bytes $((8<<30)) \
+        --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"], d
+assert d["jobs"] == 2 and d["cache_hits"] >= 1, d
+assert d["rejected_overbudget"] is True, d
+assert all(n >= 40 for n in d["windows_compared"].values()), d
+print("serveprobe: 2 jobs bit-identical to solo,", d["cache_hits"],
+      "cache hit(s) (no recompile), over-budget job rejected with advice,",
+      "daemon drained rc", d["shutdown_rc"])
+'
+    # Kill-during-submit chaos: SIGKILL the daemon at a random offset
+    # after a submission (covers mid-accept), assert NO torn spool record
+    # (the write_json_atomic / atomic-move contract), restart, and the
+    # job must complete bit-identical to the solo run.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.chaosprobe \
+        configs/serve_phold.yaml --serve 2 --seed 3 --json-only 2>/dev/null | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["ok"] and d["trials"] == 2, d
+assert d["torn_records"] == [], d
+print("chaosprobe --serve:", d["trials"], "daemon-kill trials, no torn",
+      "records, jobs bit-identical to solo")
 '
     echo "== bench regression gate (BENCH_GATE.json, ms/round per row) =="
     # ROADMAP item 5: the gate now carries THREE rows — dense smoke PHOLD,

@@ -818,6 +818,19 @@ def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
 
 
 def main(argv=None) -> int:
+    # Serve-plane subcommands (shadow1_tpu/serve/): `serve` starts the
+    # persistent multi-tenant daemon, `submit` is its client. Dispatched
+    # before the solo argparse so the solo surface (positional config +
+    # flags) stays byte-compatible for every existing caller.
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        from shadow1_tpu.serve.daemon import main as serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "submit":
+        from shadow1_tpu.serve.client import main as submit_main
+
+        return submit_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="shadow1_tpu",
         description="TPU-native discrete-event network simulator",

@@ -42,6 +42,19 @@ EXIT_MEMORY = 7      # memory plane (shadow1_tpu/mem.py): the pre-flight
                      # RESOURCE_EXHAUSTED device OOM — either way a
                      # deterministic config-vs-device condition the
                      # supervisor never respawns into
+EXIT_SERVE_SHUTDOWN = 8  # serve plane (shadow1_tpu/serve/): the daemon
+                     # drained cleanly after SIGTERM/SIGINT (or a socket
+                     # shutdown op) — the in-flight batch committed and
+                     # checkpointed, every queued job persisted to the
+                     # spool's queue.json; restarting the daemon on the
+                     # same --spool resumes exactly where it left off
+EXIT_SERVE_SPOOL = 9  # serve plane: the daemon REFUSED to start — the
+                     # --spool directory is unusable (unwritable, torn
+                     # beyond repair) or another live daemon already owns
+                     # it (daemon.json names a running pid). Job
+                     # submissions never use this code: a rejected job
+                     # exits the submit client with EXIT_CONFIG /
+                     # EXIT_MEMORY like the solo CLI would
 
 EXIT_CODES: dict[int, str] = {
     EXIT_OK: "ok",
@@ -50,6 +63,8 @@ EXIT_CODES: dict[int, str] = {
     EXIT_PREEMPTED: "preempted (graceful drain; resume to continue)",
     EXIT_HUNG: "hung (watchdog killed a stale child twice, no progress)",
     EXIT_MEMORY: "memory (over HBM budget / RESOURCE_EXHAUSTED, advice printed)",
+    EXIT_SERVE_SHUTDOWN: "serve daemon drained (queue persisted; restart to resume)",
+    EXIT_SERVE_SPOOL: "serve daemon refused to start (spool unusable or owned)",
 }
 
 # --------------------------------------------------------------------------

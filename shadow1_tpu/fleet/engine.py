@@ -226,6 +226,15 @@ class FleetEngine:
             raise FleetConfigError(
                 f"max_rounds list ({len(self.max_rounds)}) != experiment "
                 f"count ({self.n_exp})")
+        if len(set(self.max_rounds)) == 1 \
+                and self.max_rounds[0] != self.params.max_rounds:
+            # A UNIFORM list never becomes a variant leaf (the per-lane
+            # substitution in _lane_ctx fires only for non-uniform lists),
+            # so the compiled program would silently run params.max_rounds
+            # instead — normalize params to the list before anything is
+            # traced.
+            self.params = dataclasses.replace(
+                self.params, max_rounds=self.max_rounds[0])
         # Sweep-global id of lane 0 — nonzero only for a memory-downshifted
         # sub-batch (cli --on-oom downshift), so records keep global ids.
         self.exp_base = 0
@@ -367,9 +376,13 @@ class FleetEngine:
                            make_handlers=self._model.make_handlers)
 
     def _make_run(self):
-        variants = self._variants
-
-        def run(st: SimState, n_windows) -> SimState:
+        # The per-lane variants ride as a TRACED ARGUMENT, not closure
+        # constants: the compiled program is then a pure function of the
+        # state/variant SHAPES, so a later experiment set of the same shape
+        # class (new seeds, loss rates, fault schedules) reuses the
+        # already-compiled executable via ``rebind`` — the serving plane's
+        # hot-engine cache (shadow1_tpu/serve/cache.py) rests on this.
+        def run(st: SimState, n_windows, variants) -> SimState:
             def body(_, s):
                 return jax.vmap(self._lane_window_step)(s, variants)
 
@@ -382,7 +395,103 @@ class FleetEngine:
         if st is None:
             st = self.init_state()
         n = n_windows if n_windows is not None else self.n_windows
-        return self._run_jit(st, jnp.asarray(n, jnp.int32))
+        return self._run_jit(st, jnp.asarray(n, jnp.int32), self._variants)
+
+    @staticmethod
+    def _signature(variants: dict, has: dict) -> tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(variants)
+        return (tuple(sorted(has.items())), str(treedef),
+                tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+
+    def variant_signature(self) -> tuple:
+        """Trace-structure fingerprint of the variant pytree + has-flags:
+        two experiment sets whose signatures match run through the SAME
+        compiled program after ``rebind`` (jit keys on treedef + leaf
+        shapes/dtypes; the has-flags are Python-level trace gates)."""
+        return self._signature(self._variants, self._has)
+
+    def rebind(self, exps: list, max_rounds: list[int] | None = None
+               ) -> "FleetEngine":
+        """Swap a NEW experiment set of the same shape class into this
+        already-compiled engine — no re-jit, no re-trace.
+
+        The new set may differ in exactly the fleet-variable knobs (seed,
+        loss, fault schedules, legacy stop_time, per-lane max_rounds):
+        those ride the variant pytree, which ``run`` takes as a traced
+        argument. Everything the base ctx closes over (topology, window,
+        caps, model config) must be identical — the serve-plane engine
+        cache guarantees it by keying on the shape-class fingerprint
+        (serve/cache.py); this method re-checks the cheap invariants and
+        raises FleetConfigError (kind="mode") when the new set's trace
+        structure (lane count, has-flags, variant table shapes) would
+        force a recompile, so a caller can fall back to a fresh build."""
+        if len(exps) != self.n_exp:
+            raise FleetConfigError(
+                f"rebind: lane count {len(exps)} != compiled {self.n_exp} "
+                f"(state shapes differ — build a fresh engine)",
+                kind="mode", knob="n_exp")
+        for exp in exps:
+            exp.validate()
+        check_uniform(exps, [self.params] * len(exps))
+        # The compiled program closed over the OLD exps' shared constants
+        # (topology tables, horizon, model config): every field outside
+        # the fleet-variable set must compare EQUAL to the compiled one,
+        # not just within the new set — the serve cache's fingerprint
+        # guarantees this, but a direct caller gets the same wall.
+        from shadow1_tpu.fleet.expand import _VARIABLE_EXP, _np_equal
+
+        for f in (fld.name for fld in dataclasses.fields(type(self.exp))):
+            if f in _VARIABLE_EXP:
+                continue
+            if not _np_equal(getattr(self.exp, f), getattr(exps[0], f)):
+                raise FleetConfigError(
+                    f"rebind: {f!r} differs from the compiled engine's — "
+                    f"it is closed over as a device constant (or picks "
+                    f"shapes); a different shape class needs a fresh "
+                    f"engine", kind="shape", knob=f)
+        new_mr = [int(m) for m in
+                  (max_rounds or [self.params.max_rounds] * self.n_exp)]
+        if len(set(new_mr)) == 1 and new_mr[0] != self.params.max_rounds:
+            # A uniform list is baked into the compiled program as
+            # params.max_rounds (no variant leaf) — a different uniform
+            # value cannot ride a rebind.
+            raise FleetConfigError(
+                f"rebind: uniform max_rounds {new_mr[0]} != compiled "
+                f"{self.params.max_rounds} — baked into the traced round "
+                f"loop; build a fresh engine", kind="mode",
+                knob="max_rounds")
+        old_exps, old_mr = self.exps, self.max_rounds
+        old_variants, old_has = self._variants, self._has
+        self.exps = list(exps)
+        self.exp = exps[0]
+        self.max_rounds = new_mr
+        try:
+            variants, has = self._build_variants()
+            if has != old_has:
+                raise FleetConfigError(
+                    f"rebind: fault-plane trace gates changed "
+                    f"({old_has} -> {has}) — the compiled program was "
+                    f"traced without those passes; build a fresh engine",
+                    kind="mode", knob="faults")
+            if has["restart"]:
+                cap = jax.vmap(self._lane_init_model)(variants)
+                variants["init_model"] = jax.tree.map(
+                    lambda x: jnp.asarray(np.asarray(x)), cap)
+            if self._signature(variants, has) \
+                    != self._signature(old_variants, old_has):
+                raise FleetConfigError(
+                    "rebind: variant table shapes changed (fault schedule "
+                    "sizes / per-lane max_rounds presence) — a same-shape "
+                    "program cannot serve them; build a fresh engine",
+                    kind="mode", knob="variants")
+        except Exception:
+            self.exps, self.exp = old_exps, old_exps[0]
+            self.max_rounds = old_mr
+            raise
+        self._variants, self._has = variants, has
+        self.exp_base = 0
+        self.exp_ids = None
+        return self
 
     # -- accessors ---------------------------------------------------------
     @staticmethod

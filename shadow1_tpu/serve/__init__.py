@@ -1,0 +1,31 @@
+"""Simulation-as-a-service — the persistent multi-tenant engine daemon.
+
+The batch CLI pays the dominant cost — engine trace + compile, 3.3 s once
+vs 25.3 s per-seed on the measured E=16 fleet (BENCH_r06) — on EVERY
+invocation. This package turns the engine into a long-lived server so
+repeat-shape traffic never pays it again:
+
+* ``python -m shadow1_tpu serve --spool DIR``   — the daemon
+  (:mod:`serve.daemon`): accepts standard YAML experiment configs over a
+  filesystem spool + Unix-socket JSON-line protocol, admits them against
+  the live HBM budget (:mod:`shadow1_tpu.mem` pre-flight, BEFORE any
+  compile), packs shape-compatible jobs into fleet lanes
+  (:mod:`shadow1_tpu.fleet`), and streams per-job telemetry into the
+  spool;
+* ``python -m shadow1_tpu submit CONFIG --spool DIR`` — the client
+  (:mod:`serve.client`): submits, streams status, awaits the result, and
+  exits the solo CLI's taxonomy codes (EXIT_CONFIG / EXIT_MEMORY for
+  rejections, EXIT_CAPACITY for a quarantined lane);
+* the **hot engine cache** (:mod:`serve.cache`): compiled fleet engines
+  keyed by (shape class, caps, engine knobs, lane count, backend) — a
+  repeat-shape batch REBINDS its per-job variants into the cached
+  program (``FleetEngine.rebind``) and skips trace + compile entirely.
+
+The serving contract (docs/SEMANTICS.md §"Serving contract"): a job run
+through the daemon produces a digest stream and parity counters
+bit-identical to the same config run through the solo CLI — lanes are
+vmap-independent, so cohabitation is observable only in wall time.
+``tools/serveprobe.py`` proves it end-to-end per invocation.
+"""
+
+from shadow1_tpu.serve.protocol import Spool, new_job_id  # noqa: F401

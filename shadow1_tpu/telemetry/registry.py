@@ -142,10 +142,40 @@ REC_MEM = "mem"
 # outbox_hosts. Summarized by tools/heartbeat_report.py's work-efficiency
 # section; never enters ring percentile math.
 REC_WORK = "work"
+# Serve plane (shadow1_tpu/serve/, docs/OBSERVABILITY.md §"Serve
+# records"): ``serve`` = daemon-level events (start / accept / reject /
+# batch_start / batch_done / evict / shutdown — each with a ``cache``
+# hit|miss field on batch_start); ``serve_job`` = one record per job
+# state transition (queued → running → done|failed|rejected|evicted),
+# the rows heartbeat_report's serve section tabulates. Daemon-level
+# events, never per-window rows — like the digest/retry columns they
+# stay out of ring percentile math by being their own record types.
+REC_SERVE = "serve"
+REC_SERVE_JOB = "serve_job"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
                 REC_FLEET_RETRY, REC_FLEET_QUARANTINE,
-                REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK)
+                REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK,
+                REC_SERVE, REC_SERVE_JOB)
+
+# Serve-plane job-ledger namespace (shadow1_tpu/serve/daemon.py): exported
+# on the daemon's Prometheus endpoint (--metrics-port) with the
+# ``shadow1_serve`` prefix, DISTINCT from the engine counter namespace
+# above — the engines' Metrics-fields sync contract never sees these.
+SERVE_SPECS: dict[str, tuple[str, str]] = {
+    "jobs_submitted": (COUNTER, "job submissions accepted into the spool"),
+    "jobs_rejected": (COUNTER, "jobs rejected at admission (config/memory)"),
+    "jobs_done": (COUNTER, "jobs finished successfully"),
+    "jobs_failed": (COUNTER, "jobs failed (quarantined lane / runtime error)"),
+    "jobs_evicted": (COUNTER, "job evictions (priority preemption drains)"),
+    "jobs_queued": (GAUGE, "jobs waiting in the lane-packing queue"),
+    "jobs_running": (GAUGE, "jobs in the in-flight fleet batch"),
+    "batches_run": (COUNTER, "fleet batches executed"),
+    "cache_hits": (COUNTER, "hot-engine cache hits (compile skipped)"),
+    "cache_misses": (COUNTER, "hot-engine cache misses (trace + compile paid)"),
+    "cache_evictions": (COUNTER, "hot-engine cache LRU evictions"),
+    "cache_entries": (GAUGE, "compiled engines currently resident in the cache"),
+}
 
 # The drop/overflow counter group: every way a modeled event or packet can
 # be discarded, with the human-readable reason. Heartbeat records and the
@@ -244,19 +274,27 @@ def _escape_label(s: str) -> str:
 
 
 def to_prometheus(metrics: dict, prefix: str = "shadow1",
-                  labels: dict | None = None) -> str:
+                  labels: dict | None = None,
+                  specs: dict | None = None) -> str:
     """Prometheus text exposition (version 0.0.4) of a metrics dict.
 
     Canonical counters are exported as ``<prefix>_<name>_total``, gauges as
-    ``<prefix>_<name>``; unknown extras default to counter kind."""
+    ``<prefix>_<name>``; unknown extras default to counter kind. ``specs``
+    selects the namespace table (default METRIC_SPECS; the serve daemon's
+    job ledger exports through SERVE_SPECS instead — dicts are then taken
+    as-is, no engine-counter normalization)."""
     lab = ""
     if labels:
         inner = ",".join(f'{k}="{_escape_label(str(v))}"'
                          for k, v in sorted(labels.items()))
         lab = "{" + inner + "}"
     lines = []
-    for name, value in normalize(metrics).items():
-        kind, help_ = METRIC_SPECS.get(name, (COUNTER, "engine-specific counter"))
+    table = METRIC_SPECS if specs is None else specs
+    rows = normalize(metrics) if specs is None else \
+        {**{n: int(metrics.get(n, 0)) for n in table},
+         **{k: v for k, v in metrics.items() if k not in table}}
+    for name, value in rows.items():
+        kind, help_ = table.get(name, (COUNTER, "engine-specific counter"))
         metric = f"{prefix}_{name}" + ("_total" if kind == COUNTER else "")
         lines.append(f"# HELP {metric} {_escape_help(help_)}")
         lines.append(f"# TYPE {metric} {kind}")
@@ -278,11 +316,13 @@ class ExpositionServer:
     """
 
     def __init__(self, get_metrics, port: int = 0, host: str = "127.0.0.1",
-                 prefix: str = "shadow1", labels: dict | None = None):
+                 prefix: str = "shadow1", labels: dict | None = None,
+                 specs: dict | None = None):
         self.get_metrics = get_metrics
         self._addr = (host, port)
         self.prefix = prefix
         self.labels = labels
+        self.specs = specs
         self._httpd = None
         self._thread = None
 
@@ -301,7 +341,8 @@ class ExpositionServer:
             def do_GET(self):  # noqa: N802 (stdlib API name)
                 if self.path.rstrip("/") in ("", "/metrics"):
                     body = to_prometheus(reg.get_metrics(), prefix=reg.prefix,
-                                         labels=reg.labels).encode()
+                                         labels=reg.labels,
+                                         specs=reg.specs).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4; charset=utf-8")

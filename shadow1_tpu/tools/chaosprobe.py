@@ -155,6 +155,123 @@ def _corrupt_file(path: str) -> None:
         f.truncate(max(size // 2, 1))
 
 
+def _serve_trials(args) -> int:
+    """``--serve N``: the kill-anywhere matrix for the serve daemon.
+
+    Each trial: fresh spool → daemon up → submit a job → SIGKILL the
+    daemon at a random real-time offset (covering kill-during-accept,
+    kill-mid-validate, kill-mid-batch, kill-after-done) → assert the
+    spool holds NO torn record (every ``*.json`` parses — the
+    write_json_atomic / atomic-move contract) → restart the daemon on
+    the same spool → the job must complete with a digest stream
+    bit-identical to the solo CLI run (a from-scratch rerun is
+    bit-identical by determinism; a finished job survives untouched).
+    The final daemon is SIGTERM-drained (EXIT_SERVE_SHUTDOWN checked)."""
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.consts import EXIT_SERVE_SHUTDOWN
+    from shadow1_tpu.serve import client
+    from shadow1_tpu.serve.protocol import Spool
+    from shadow1_tpu.tools.serveprobe import _served_stream, _solo_stream
+
+    rng = random.Random(args.seed)
+    work = tempfile.mkdtemp(prefix="chaosserve_")
+    say = (lambda *a: None) if args.json_only else (
+        lambda *a: print(*a, file=sys.stderr, flush=True))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    ref = _solo_stream(args.config, args.windows, args.timeout_s, env)
+    if not ref:
+        print(json.dumps({"ok": False, "error": "solo reference emitted "
+                          "no ring digest rows — the config needs "
+                          "engine metrics_ring + state_digest"}))
+        return 1
+    say(f"[chaosprobe --serve] solo reference: {len(ref)} digest rows")
+
+    def spawn(spool, err_path):
+        ef = open(err_path, "a")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "shadow1_tpu", "serve",
+             "--spool", spool, "--poll-s", "0.05"],
+            env=env, stdout=subprocess.DEVNULL, stderr=ef)
+        deadline = time.monotonic() + 60
+        while Spool(spool).daemon_alive() is None:
+            if p.poll() is not None or time.monotonic() > deadline:
+                raise RuntimeError(f"daemon did not start (rc={p.poll()})")
+            time.sleep(0.05)
+        return p, ef
+
+    verdicts = []
+    torn = []
+    for ti in range(args.serve):
+        spool = os.path.join(work, f"t{ti}")
+        errp = os.path.join(work, f"t{ti}.stderr")
+        # Any trial-infrastructure failure (daemon won't start, SIGTERM
+        # wait expires, spool IO) must still end in the JSON verdict
+        # contract (ci.sh parses the last stdout line), never a raw
+        # traceback with empty stdout.
+        p = ef = jid = None
+        rc = None
+        final = {}
+        try:
+            p, ef = spawn(spool, errp)
+            jid = client.submit(spool, args.config)
+            # Kill offset sweeps the whole lifecycle: ~0 = mid-accept.
+            time.sleep(rng.uniform(0.0, args.serve_kill_s))
+            p.kill()
+            p.wait()
+            ef.close()
+            # Torn-record sweep: every committed .json must parse whole.
+            for root, _, names in os.walk(spool):
+                for name in names:
+                    if not name.endswith(".json"):
+                        continue
+                    fp = os.path.join(root, name)
+                    try:
+                        with open(fp) as f:
+                            json.load(f)
+                    except ValueError:
+                        torn.append(os.path.relpath(fp, spool))
+            p, ef = spawn(spool, errp)
+            try:
+                final = client.await_job(Spool(spool), jid,
+                                         timeout_s=args.timeout_s,
+                                         poll_s=0.05)
+            except TimeoutError as e:
+                final = {"state": f"timeout: {e}"}
+            finally:
+                p.send_signal(signal.SIGTERM)
+                rc = p.wait(timeout=60)
+        except (RuntimeError, OSError,
+                subprocess.TimeoutExpired) as e:
+            final = final or {"state": f"error: {e}"}
+        finally:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            if ef is not None:
+                ef.close()
+        served = _served_stream(spool, jid) if jid is not None else {}
+        common = sorted(set(served) & set(ref))
+        bad = [w for w in common if served[w] != ref[w]]
+        ok = (final.get("state") == "done" and not bad and common
+              and rc == EXIT_SERVE_SHUTDOWN)
+        verdicts.append({"trial": ti, "state": final.get("state"),
+                         "windows_compared": len(common),
+                         "first_divergence": bad[:1],
+                         "shutdown_rc": rc, "ok": bool(ok)})
+        say(f"[chaosprobe --serve] trial {ti}: {final.get('state')}, "
+            f"{len(common)} windows vs solo"
+            + (" — DIVERGED" if bad else ", bit-identical"))
+    ok = not torn and all(v["ok"] for v in verdicts)
+    print(json.dumps({"ok": ok, "trials": args.serve,
+                      "torn_records": torn, "verdicts": verdicts}))
+    if torn or not ok:
+        return EXIT_DIVERGED if any(v["first_divergence"]
+                                    for v in verdicts) else 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.chaosprobe")
     ap.add_argument("config", help="YAML experiment file")
@@ -183,10 +300,23 @@ def main(argv=None) -> int:
                     help="skip the cpu-oracle digest cross-check of the "
                          "straight run (solo only; fleet skips it anyway "
                          "— tools/fleetprobe.py covers fleet↔oracle)")
+    ap.add_argument("--serve", type=int, default=0, metavar="N",
+                    help="run N serve-daemon kill trials instead of the "
+                         "supervised matrix: SIGKILL the daemon at a "
+                         "random offset after a submission (including "
+                         "mid-accept), assert no torn spool record, "
+                         "restart, and bit-compare the completed job "
+                         "against the solo run (the config needs "
+                         "engine metrics_ring + state_digest)")
+    ap.add_argument("--serve-kill-s", type=float, default=2.0,
+                    help="--serve: max random kill offset after submit")
     ap.add_argument("--timeout-s", type=float, default=600.0,
                     help="per-launch wall timeout")
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.serve:
+        return _serve_trials(args)
 
     rng = random.Random(args.seed)
     work = tempfile.mkdtemp(prefix="chaosprobe_")

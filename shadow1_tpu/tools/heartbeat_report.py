@@ -31,6 +31,8 @@ from shadow1_tpu.telemetry.registry import (
     REC_RESUME,
     REC_RING,
     REC_RING_GAP,
+    REC_SERVE,
+    REC_SERVE_JOB,
     REC_TRACKER,
     REC_WORK,
     RING_COUNTERS,
@@ -414,6 +416,59 @@ def summarize(recs: list[dict], out=None) -> dict:
                 detail = str(r.get("message", ""))[:160]
             print(f"  ERROR {r['error']}{where}: {detail}", file=out)
         summary["memory"] = msum
+    serve_ev = [r for r in recs if r.get("type") == REC_SERVE]
+    serve_jobs = [r for r in recs if r.get("type") == REC_SERVE_JOB]
+    if serve_ev or serve_jobs:
+        # Serve plane (shadow1_tpu/serve/): the daemon's job ledger from
+        # its serve.log stream. Daemon-level events, never per-window rows
+        # — the same digest/retry-column rule keeps every serve field out
+        # of the ring percentile math below.
+        by_job: dict[str, list[dict]] = {}
+        for r in serve_jobs:
+            by_job.setdefault(r.get("job", "?"), []).append(r)
+        batches = [r for r in serve_ev if r.get("event") == "batch_start"]
+        evicts = [r for r in serve_ev if r.get("event") == "evict"]
+        cache = {"hit": 0, "miss": 0}
+        for b in batches:
+            if b.get("cache") in cache:
+                cache[b["cache"]] += 1
+        ssum = {
+            "jobs": len(by_job),
+            "batches": len(batches),
+            "cache_hits": cache["hit"],
+            "cache_misses": cache["miss"],
+            "evictions": len(evicts),
+        }
+        shutdown = next((r for r in reversed(serve_ev)
+                         if r.get("event") == "shutdown"), None)
+        if shutdown and isinstance(shutdown.get("ledger"), dict):
+            ssum["ledger"] = shutdown["ledger"]
+        summary["serve"] = ssum
+        print("== serve (daemon job ledger) ==", file=out)
+        print(f"  jobs: {len(by_job)}  batches: {len(batches)}  "
+              f"engine cache: {cache['hit']} hit / {cache['miss']} miss"
+              f"  evictions: {len(evicts)}", file=out)
+        for job_id in sorted(by_job):
+            rows = by_job[job_id]
+            last = rows[-1]
+            run = next((r for r in rows if r.get("state") == "running"
+                        and "lane" in r), None)
+            t0 = next((r.get("t") for r in rows
+                       if r.get("t") is not None), None)
+            t1 = next((r.get("t") for r in reversed(rows)
+                       if r.get("t") is not None), None)
+            wall = (f"  wall {t1 - t0:.1f}s"
+                    if t0 is not None and t1 is not None and t1 > t0
+                    else "")
+            lane = (f"  lane {run['lane']}/{run['lanes']}"
+                    if run is not None else "")
+            cached = (f"  cache {run['cache']}"
+                      if run is not None and run.get("cache") else "")
+            ev = sum(1 for r in rows if r.get("state") == "evicted")
+            evs = f"  evicted x{ev}" if ev else ""
+            fin = "  [finished early]" if last.get("finished_early") else ""
+            print(f"  {job_id}: {last.get('state')}{lane}{cached}{evs}"
+                  f"{wall}{fin}", file=out)
     if rings:
         # Fleet runs tag each ring row with its experiment id (``exp``):
         # group the per-window stats PER EXPERIMENT — mixing lanes would

@@ -1,0 +1,248 @@
+"""serveprobe — end-to-end proof of the serving contract.
+
+Spawns a real daemon (subprocess, CPU-safe), drives it like a tenant
+population, and verifies the acceptance gates of the serve plane
+(docs/SEMANTICS.md §"Serving contract") in one invocation:
+
+1. **round-trip bit-exactness**: every completed job's digest stream
+   (the ring rows routed into its ``result.jsonl``) bit-matches the solo
+   CLI run of the same config — packed-lane execution is invisible to
+   the tenant;
+2. **hot-engine cache**: same-shape jobs submitted SEQUENTIALLY (so they
+   land in separate batches) must hit the cache from the second batch on
+   — asserted from the daemon ledger's hit counter, i.e. no re-trace, no
+   recompile;
+3. **admission control**: an over-budget submission (``--overbudget``
+   config) is rejected pre-compile with the ``error=memory_budget``
+   advice record and the submit client exits EXIT_MEMORY — while the
+   resident jobs complete normally;
+4. **graceful shutdown**: SIGTERM drains the daemon and exits
+   EXIT_SERVE_SHUTDOWN.
+
+Exit codes: 0 = all gates pass; 3 = digest divergence (the fleetprobe
+convention — a determinism bug, not a serve bug); 1 = any other failure.
+
+Usage::
+
+    python -m shadow1_tpu.tools.serveprobe CONFIG --seeds 5,6 \
+        [--overbudget BIGCONFIG] [--mem-bytes N] [--windows W] [--json-only]
+
+CONFIG needs ``engine: {metrics_ring: W, state_digest: 1}`` so both the
+daemon lanes and the solo reference emit the digest stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+EXIT_DIVERGED = 3
+
+
+def _solo_stream(config_path: str, windows, timeout_s: float,
+                 env) -> dict[int, tuple]:
+    """window → digest-word tuple from a solo CLI run's stderr rings."""
+    from shadow1_tpu.core.digest import DIGEST_FIELDS
+
+    cmd = [sys.executable, "-m", "shadow1_tpu", config_path]
+    if windows is not None:
+        cmd += ["--windows", str(windows)]
+    r = subprocess.run(cmd, env=env, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.PIPE, text=True,
+                       timeout=timeout_s)
+    if r.returncode != 0:
+        raise RuntimeError(f"solo reference run failed rc={r.returncode}: "
+                           f"{r.stderr[-800:]}")
+    out = {}
+    for line in r.stderr.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("type") == "ring":
+            out[rec["window"]] = tuple(rec[f] for f in DIGEST_FIELDS)
+    return out
+
+
+def _served_stream(spool_dir: str, job_id: str) -> dict[int, tuple]:
+    from shadow1_tpu.core.digest import DIGEST_FIELDS
+    from shadow1_tpu.serve.protocol import Spool
+
+    out = {}
+    for rec in Spool(spool_dir).read_results(job_id):
+        if rec.get("type") == "ring":
+            out[rec["window"]] = tuple(rec[f] for f in DIGEST_FIELDS)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.serveprobe")
+    ap.add_argument("config", help="YAML experiment file (must carry "
+                                   "engine metrics_ring + state_digest)")
+    ap.add_argument("--seeds", default="5,6",
+                    help="comma list: one same-shape job per seed, "
+                         "submitted sequentially (cache-hit proof needs "
+                         ">= 2)")
+    ap.add_argument("--overbudget", default=None, metavar="CFG",
+                    help="config expected to FAIL admission (memory "
+                         "budget) — e.g. configs/mem_overbudget.yaml")
+    ap.add_argument("--mem-bytes", type=int, default=None,
+                    help="SHADOW1_MEM_BYTES for the daemon (the CPU "
+                         "backend reports no device memory)")
+    ap.add_argument("--windows", type=int, default=None)
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args(argv)
+
+    import yaml
+
+    import shadow1_tpu  # noqa: F401
+    from shadow1_tpu.consts import EXIT_MEMORY, EXIT_SERVE_SHUTDOWN
+    from shadow1_tpu.serve.protocol import Spool, request
+
+    say = (lambda *a: None) if args.json_only else (
+        lambda *a: print(*a, file=sys.stderr, flush=True))
+    work = tempfile.mkdtemp(prefix="serveprobe_")
+    spool = os.path.join(work, "spool")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if args.mem_bytes is not None:
+        env["SHADOW1_MEM_BYTES"] = str(args.mem_bytes)
+
+    with open(args.config) as f:
+        base_doc = yaml.safe_load(f.read())
+    seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    cfgs = []
+    for i, seed in enumerate(seeds):
+        doc = json.loads(json.dumps(base_doc))  # deep copy
+        doc.setdefault("general", {})["seed"] = seed
+        p = os.path.join(work, f"job{i}.yaml")
+        with open(p, "w") as f:
+            yaml.safe_dump(doc, f)
+        cfgs.append(p)
+
+    def fail(msg: str, rc: int = 1, **extra) -> int:
+        print(json.dumps({"ok": False, "error": msg, **extra}))
+        return rc
+
+    daemon_err = open(os.path.join(work, "daemon.stderr"), "w")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "shadow1_tpu", "serve", "--spool", spool,
+         "--poll-s", "0.05"],
+        env=env, stdout=subprocess.DEVNULL, stderr=daemon_err)
+    try:
+        deadline = time.monotonic() + 60
+        while Spool(spool).daemon_alive() is None:
+            if daemon.poll() is not None or time.monotonic() > deadline:
+                return fail(f"daemon did not start (rc={daemon.poll()})")
+            time.sleep(0.1)
+        say(f"[serveprobe] daemon up (pid {daemon.pid})")
+
+        # ---- sequential same-shape jobs (cache-hit proof) ---------------
+        job_ids = []
+        for i, cfg in enumerate(cfgs):
+            cmd = [sys.executable, "-m", "shadow1_tpu", "submit", cfg,
+                   "--spool", spool, "--timeout-s", str(args.timeout_s),
+                   "--json-only"]
+            if args.windows is not None:
+                cmd += ["--windows", str(args.windows)]
+            r = subprocess.run(cmd, env=env, capture_output=True,
+                               text=True, timeout=args.timeout_s + 30)
+            if r.returncode != 0:
+                return fail(f"job {i} (seed {seeds[i]}) failed "
+                            f"rc={r.returncode}", stderr=r.stderr[-500:])
+            final = json.loads(r.stdout.strip().splitlines()[-1])
+            job_ids.append(final["job"])
+            say(f"[serveprobe] job {i} done: {final['job']} "
+                f"(cache {final.get('cache')})")
+
+        ledger = request(Spool(spool).sock_path,
+                         {"op": "ping"})["ledger"]
+        if len(seeds) >= 2 and ledger.get("cache_hits", 0) < len(seeds) - 1:
+            return fail(f"expected >= {len(seeds) - 1} engine-cache "
+                        f"hit(s), ledger says {ledger}", ledger=ledger)
+
+        # ---- over-budget admission rejection ----------------------------
+        rejected = None
+        if args.overbudget:
+            r = subprocess.run(
+                [sys.executable, "-m", "shadow1_tpu", "submit",
+                 args.overbudget, "--spool", spool,
+                 "--timeout-s", str(args.timeout_s), "--json-only"],
+                env=env, capture_output=True, text=True,
+                timeout=args.timeout_s + 30)
+            if r.returncode != EXIT_MEMORY:
+                return fail(f"over-budget submit: expected EXIT_MEMORY="
+                            f"{EXIT_MEMORY}, got rc={r.returncode}",
+                            stderr=r.stderr[-500:])
+            rejected = json.loads(r.stdout.strip().splitlines()[-1])
+            err = rejected.get("error") or {}
+            if err.get("error") != "memory_budget" \
+                    or "Remedies" not in (err.get("advice") or ""):
+                return fail("over-budget rejection lacks the "
+                            "memory_budget advice record", status=rejected)
+            say(f"[serveprobe] over-budget job rejected pre-compile "
+                f"({err['estimated'] >> 20} MiB est vs "
+                f"{err['budget'] >> 20} MiB budget), advice present")
+
+        # ---- digest round-trip vs solo CLI ------------------------------
+        mismatches = []
+        compared = {}
+        for i, (jid, cfg) in enumerate(zip(job_ids, cfgs)):
+            served = _served_stream(spool, jid)
+            solo = _solo_stream(cfg, args.windows, args.timeout_s, env)
+            common = sorted(set(served) & set(solo))
+            if not common:
+                return fail(f"job {i}: no comparable ring windows "
+                            f"(served {len(served)}, solo {len(solo)}) — "
+                            f"does the config carry metrics_ring + "
+                            f"state_digest?")
+            bad = [w for w in common if served[w] != solo[w]]
+            compared[jid] = len(common)
+            if bad:
+                mismatches.append({"job": jid, "first_window": bad[0]})
+            say(f"[serveprobe] job {i}: {len(common)} windows compared "
+                f"vs solo{' — DIVERGED' if bad else ', bit-identical'}")
+        if mismatches:
+            print(json.dumps({
+                "ok": False, "error": "served digest stream diverges "
+                "from the solo CLI run", "mismatches": mismatches,
+                "paritytrace": f"python -m shadow1_tpu.tools.paritytrace "
+                               f"{args.config} tpu cpu"}))
+            return EXIT_DIVERGED
+
+        # ---- graceful shutdown ------------------------------------------
+        daemon.send_signal(signal.SIGTERM)
+        rc = daemon.wait(timeout=60)
+        if rc != EXIT_SERVE_SHUTDOWN:
+            return fail(f"daemon drain: expected EXIT_SERVE_SHUTDOWN="
+                        f"{EXIT_SERVE_SHUTDOWN}, got rc={rc}")
+        say(f"[serveprobe] daemon drained cleanly (rc={rc})")
+        print(json.dumps({
+            "ok": True,
+            "jobs": len(job_ids),
+            "windows_compared": compared,
+            "ledger": ledger,
+            "cache_hits": ledger.get("cache_hits", 0),
+            "rejected_overbudget": bool(rejected),
+            "shutdown_rc": rc,
+        }))
+        return 0
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+        daemon_err.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,488 @@
+"""Serve plane (shadow1_tpu/serve/) — daemon, cache, admission, packing.
+
+The serving contract under test (docs/SEMANTICS.md §"Serving contract"):
+
+* engine-cache keying: a same-shape repeat batch HITS (rebind, zero new
+  jit traces); a changed cap MISSES (different state shapes);
+* admission control rejects an over-budget submission BEFORE any engine
+  is built, with the standard memory_budget advice record;
+* lane-packed jobs produce digest streams bit-identical to their solo
+  runs; a halting tenant quarantines without touching cohabitants;
+* a higher-priority submission evicts the running batch through the
+  preemption plane and the evicted job resumes bit-identically;
+* daemon SIGTERM drains, persists the queue, and a restart finishes the
+  work (slow, subprocess).
+
+In-process tests drive the daemon through ``ServeDaemon.step()`` — the
+exact scheduler iteration the live loop runs — so the fast tier needs no
+subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import shadow1_tpu  # noqa: F401
+from shadow1_tpu.ckpt import run_chunked
+from shadow1_tpu.config.experiment import load_experiment
+from shadow1_tpu.consts import (
+    EXIT_CAPACITY,
+    EXIT_CONFIG,
+    EXIT_MEMORY,
+    EXIT_OK,
+    EXIT_SERVE_SHUTDOWN,
+)
+from shadow1_tpu.core.digest import DIGEST_FIELDS
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.serve import client
+from shadow1_tpu.serve.cache import EngineCache, shape_class_key
+from shadow1_tpu.serve.daemon import ServeDaemon
+from shadow1_tpu.serve.protocol import Spool
+from shadow1_tpu.telemetry.ring import drain_ring
+
+BASE = """
+general: {{seed: {seed}, stop_time: {stop} ms}}
+engine: {{scheduler: tpu, ev_cap: {ev_cap}, metrics_ring: 10,
+          state_digest: 1{extra_engine}}}
+network: {{single_vertex: {{latency: 1 ms{loss}}}}}
+hosts:
+  - {{name: h, count: 8}}
+app:
+  model: phold
+  params: {{mean_delay_ns: 2000000.0, init_events: {init_events}}}
+"""
+
+
+def write_cfg(tmp_path, name, seed=5, stop=40, ev_cap=32, loss=None,
+              init_events=3, extra_engine=""):
+    p = tmp_path / name
+    p.write_text(BASE.format(
+        seed=seed, stop=stop, ev_cap=ev_cap, init_events=init_events,
+        loss=(f", loss: {loss}" if loss is not None else ""),
+        extra_engine=extra_engine))
+    return str(p)
+
+
+def solo_stream(cfg_path) -> dict[int, tuple]:
+    """window → digest words of the straight solo run (full stream:
+    chunked at the ring depth so no row is overwritten undrained)."""
+    exp, params, _ = load_experiment(cfg_path)
+    eng = Engine(exp, params)
+    rows: dict[int, tuple] = {}
+    start = [0]
+
+    def on_chunk(st, _d):
+        for r in drain_ring(st, eng.window, start=start[0]):
+            if r["type"] == "ring":
+                rows[r["window"]] = tuple(r[f] for f in DIGEST_FIELDS)
+        start[0] = int(st.metrics.windows)
+
+    run_chunked(eng, n_windows=eng.n_windows, chunk=params.metrics_ring,
+                on_chunk=on_chunk)
+    return rows
+
+
+def served_stream(spool_dir, job_id) -> dict[int, tuple]:
+    return {r["window"]: tuple(r[f] for f in DIGEST_FIELDS)
+            for r in Spool(spool_dir).read_results(job_id)
+            if r.get("type") == "ring"}
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = ServeDaemon(str(tmp_path / "spool"), poll_s=0.05,
+                    ckpt_every_s=1e9)
+    d.start()
+    yield d
+    d.close()
+
+
+# ---------------------------------------------------------------------------
+# engine cache
+# ---------------------------------------------------------------------------
+
+def test_cache_same_shape_hit_no_retrace(tmp_path):
+    cache = EngineCache()
+    exp_a = load_experiment(write_cfg(tmp_path, "a.yaml", seed=5))[0]
+    exp_b, params, _ = load_experiment(write_cfg(tmp_path, "b.yaml",
+                                                 seed=9))
+    eng1, out1 = cache.get([exp_a], params)
+    assert out1 == "miss"
+    eng1.run(n_windows=4)
+    n_traces = eng1._run_jit._cache_size()
+    eng2, out2 = cache.get([exp_b], params)
+    assert out2 == "hit" and eng2 is eng1
+    assert eng2.exp.seed == 9  # rebound to the new lane set
+    eng2.run(n_windows=4)
+    # THE cache contract: a hit never traces or compiles anything new.
+    assert eng2._run_jit._cache_size() == n_traces
+    assert cache.counters()["cache_hits"] == 1
+
+
+def test_cache_changed_cap_misses(tmp_path):
+    import dataclasses
+
+    cache = EngineCache()
+    exp, params, _ = load_experiment(write_cfg(tmp_path, "a.yaml"))
+    _, out1 = cache.get([exp], params)
+    _, out2 = cache.get([exp], dataclasses.replace(params, ev_cap=64))
+    assert (out1, out2) == ("miss", "miss")
+    assert cache.counters() == {"cache_hits": 0, "cache_misses": 2,
+                                "cache_evictions": 0, "cache_entries": 2}
+    # and the keys really differ only by the cap
+    k1 = shape_class_key(exp, params, 1)
+    k2 = shape_class_key(exp, dataclasses.replace(params, ev_cap=64), 1)
+    assert k1[0] == k2[0] and k1 != k2
+
+
+def test_rebind_refuses_trace_incompatible_sets(tmp_path):
+    from shadow1_tpu.fleet.engine import FleetEngine
+    from shadow1_tpu.fleet.expand import FleetConfigError
+
+    exp, params, _ = load_experiment(write_cfg(tmp_path, "a.yaml"))
+    eng = FleetEngine([exp], params)
+    # A uniform max_rounds is baked into the compiled program — a
+    # different uniform value cannot ride a rebind (silent wrong results
+    # otherwise: the metadata would claim R2 while the executable runs R).
+    with pytest.raises(FleetConfigError, match="max_rounds"):
+        eng.rebind([exp], max_rounds=[params.max_rounds + 1])
+    # A shape-class field differing from the COMPILED engine's (not just
+    # within the new set) is refused — it is closed over as a constant.
+    cfg2 = tmp_path / "lat.yaml"
+    cfg2.write_text((tmp_path / "a.yaml").read_text().replace(
+        "latency: 1 ms", "latency: 2 ms"))
+    exp2 = load_experiment(str(cfg2))[0]
+    with pytest.raises(FleetConfigError, match="lat_vv|window"):
+        eng.rebind([exp2])
+    assert eng.exp is exp  # failed rebinds roll back cleanly
+
+
+def test_restart_sweeps_stale_batch_lineage_keeps_quarantines(tmp_path):
+    spool = Spool(str(tmp_path / "s")).ensure()
+    for name, body in (("b000003.npz", "stale"),
+                       ("b000003.npz.lineage", "{}"),
+                       ("b000003.npz.q1.npz", "deliverable")):
+        with open(os.path.join(spool.batches, name), "w") as f:
+            f.write(body)
+    d = ServeDaemon(str(tmp_path / "s"))
+    d.start()
+    try:
+        # The dead incarnation's lineage is swept (a torn head there
+        # would make a NEW batch's resolve fall back onto a different
+        # batch's snapshot) ...
+        assert not os.path.exists(
+            os.path.join(spool.batches, "b000003.npz"))
+        assert not os.path.exists(
+            os.path.join(spool.batches, "b000003.npz.lineage"))
+        # ... the quarantined tenant's solo-resumable ckpt is kept ...
+        assert os.path.exists(
+            os.path.join(spool.batches, "b000003.npz.q1.npz"))
+        # ... and new batch ids start past every name ever seen.
+        assert d._batch_seq == 4
+    finally:
+        d.close()
+
+
+def test_cache_lru_evicts(tmp_path):
+    import dataclasses
+
+    cache = EngineCache(capacity=1)
+    exp, params, _ = load_experiment(write_cfg(tmp_path, "a.yaml"))
+    cache.get([exp], params)
+    cache.get([exp], dataclasses.replace(params, ev_cap=64))
+    assert cache.counters()["cache_evictions"] == 1
+    assert len(cache) == 1
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_rejects_overbudget_before_compile(tmp_path, daemon,
+                                                     monkeypatch):
+    monkeypatch.setenv("SHADOW1_MEM_BYTES", str(1 << 20))  # 1 MiB
+    big = tmp_path / "big.yaml"
+    big.write_text(BASE.format(seed=5, stop=40, ev_cap=64,
+                               init_events=3, loss="",
+                               extra_engine="").replace(
+        "count: 8", "count: 4096"))
+    jid = client.submit(daemon.spool.root, str(big))
+    daemon.step()
+    st = daemon.spool.read_status(jid)
+    assert st["state"] == "rejected", st
+    err = st["error"]
+    assert err["error"] == "memory_budget"
+    assert err["estimated"] > err["budget"] == (1 << 20)
+    assert "Remedies" in err["advice"]
+    # rejected BEFORE any engine was built: the cache never saw a miss
+    assert daemon.cache.counters()["cache_misses"] == 0
+    assert client.exit_code_for(st) == EXIT_MEMORY
+
+
+def test_admission_rejects_bad_configs(tmp_path, daemon):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("general: {seed: 1}\nnot_a_section: {x: 1}\n")
+    jid = client.submit(daemon.spool.root, str(bad))
+    sweep = tmp_path / "sweep.yaml"
+    sweep.write_text(BASE.format(seed=5, stop=40, ev_cap=32,
+                                 init_events=3, loss="",
+                                 extra_engine="") + "sweep: {count: 2}\n")
+    jid2 = client.submit(daemon.spool.root, str(sweep))
+    daemon.step()
+    for j in (jid, jid2):
+        st = daemon.spool.read_status(j)
+        assert st["state"] == "rejected", st
+        assert st["error"]["error"] == "config"
+        assert client.exit_code_for(st) == EXIT_CONFIG
+    assert daemon.ledger_dict()["jobs_rejected"] == 2
+
+
+# ---------------------------------------------------------------------------
+# lane packing ≡ solo bit-exactness
+# ---------------------------------------------------------------------------
+
+def test_packed_jobs_bitexact_vs_solo(tmp_path, daemon):
+    cfgs = [write_cfg(tmp_path, f"j{i}.yaml", seed=5 + i)
+            for i in range(3)]
+    jids = [client.submit(daemon.spool.root, c) for c in cfgs]
+    assert daemon.step()
+    # one batch, three lanes, one compile
+    assert daemon.ledger_dict()["batches_run"] == 1
+    assert daemon.cache.counters()["cache_misses"] == 1
+    for jid, cfg in zip(jids, cfgs):
+        st = daemon.spool.read_status(jid)
+        assert st["state"] == "done", st
+        assert st["lanes"] == 3
+        served = served_stream(daemon.spool.root, jid)
+        solo = solo_stream(cfg)
+        assert served == solo  # every window, every digest word
+        assert client.exit_code_for(st) == EXIT_OK
+
+
+def test_incompatible_shapes_batch_separately(tmp_path, daemon):
+    a = client.submit(daemon.spool.root,
+                      write_cfg(tmp_path, "a.yaml", seed=5))
+    b = client.submit(daemon.spool.root,
+                      write_cfg(tmp_path, "b.yaml", seed=6, ev_cap=64))
+    daemon.step()
+    daemon.step()
+    assert daemon.ledger_dict()["batches_run"] == 2
+    for j in (a, b):
+        assert daemon.spool.read_status(j)["state"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# quarantine isolation
+# ---------------------------------------------------------------------------
+
+def test_quarantine_isolates_halting_tenant(tmp_path, daemon):
+    # Shared shape class at ev_cap 8 under on_overflow=halt: the lossless
+    # tenant overflows (every phold hop survives), the lossy one fits.
+    halting = write_cfg(tmp_path, "halting.yaml", seed=5, ev_cap=8,
+                        init_events=6,
+                        extra_engine=", on_overflow: halt")
+    ok = write_cfg(tmp_path, "ok.yaml", seed=6, ev_cap=8, loss=0.5,
+                   init_events=6, extra_engine=", on_overflow: halt")
+    j_bad = client.submit(daemon.spool.root, halting)
+    j_ok = client.submit(daemon.spool.root, ok)
+    daemon.step()
+    st_bad = daemon.spool.read_status(j_bad)
+    assert st_bad["state"] == "failed", st_bad
+    assert st_bad["reason"] == "capacity"
+    assert client.exit_code_for(st_bad) == EXIT_CAPACITY
+    quar = [r for r in Spool(daemon.spool.root).read_results(j_bad)
+            if r.get("type") == "fleet_quarantine"]
+    assert quar and os.path.exists(quar[0]["ckpt"])
+    st_ok = daemon.spool.read_status(j_ok)
+    assert st_ok["state"] == "done", st_ok
+    assert served_stream(daemon.spool.root, j_ok) == solo_stream(ok)
+
+
+# ---------------------------------------------------------------------------
+# priority eviction → requeue → bit-identical resume
+# ---------------------------------------------------------------------------
+
+def test_eviction_requeues_and_resumes_bitexact(tmp_path, daemon):
+    lo_cfg = write_cfg(tmp_path, "lo.yaml", seed=5, stop=200)
+    hi_cfg = write_cfg(tmp_path, "hi.yaml", seed=6, stop=40)
+    j_lo = client.submit(daemon.spool.root, lo_cfg, priority=0)
+
+    def late_submit():
+        time.sleep(0.3)  # lands mid-batch; the latch sees it at a boundary
+        client.submit(daemon.spool.root, hi_cfg, priority=5)
+
+    t = threading.Thread(target=late_submit)
+    t.start()
+    daemon.step()   # j_lo's batch — evicted when the hi-pri job arrives
+    t.join()
+    st_lo = daemon.spool.read_status(j_lo)
+    assert st_lo["state"] == "queued" and st_lo.get("resumed"), st_lo
+    assert daemon.ledger_dict()["jobs_evicted"] == 1
+    assert daemon.resume, "evicted batch must leave a resume cursor"
+    daemon.step()   # the high-priority batch
+    daemon.step()   # the evicted batch resumes from its checkpoint
+    st_lo = daemon.spool.read_status(j_lo)
+    assert st_lo["state"] == "done", st_lo
+    # The stream spans eviction + resume and still bit-matches solo.
+    assert served_stream(daemon.spool.root, j_lo) == solo_stream(lo_cfg)
+    hi_jobs = [r for r in Spool(daemon.spool.root).scan_inbox()]
+    assert hi_jobs == []  # everything drained
+
+
+# ---------------------------------------------------------------------------
+# spool protocol
+# ---------------------------------------------------------------------------
+
+def test_spool_submit_is_atomic_and_accept_moves_once(tmp_path):
+    spool = Spool(str(tmp_path / "s")).ensure()
+    jid = spool.submit({"config_yaml": "x: 1", "priority": 0})
+    (path, job), = spool.scan_inbox()
+    assert job["id"] == jid
+    # a .tmp from an in-flight atomic write is invisible
+    open(os.path.join(spool.inbox, "zz.json.tmp"), "w").write("{")
+    assert len(spool.scan_inbox()) == 1
+    spool.accept(path, job)
+    assert spool.scan_inbox() == []
+    with open(spool.job_path(jid)) as f:
+        assert json.load(f)["id"] == jid
+
+
+def test_unparseable_submission_rejected_not_fatal(tmp_path, daemon):
+    with open(os.path.join(daemon.spool.inbox, "hand.json"), "w") as f:
+        f.write("{torn")
+    daemon._intake()
+    assert daemon.ledger_dict()["jobs_rejected"] == 1
+    assert os.path.exists(os.path.join(daemon.spool.inbox,
+                                       "hand.json.bad"))
+
+
+def test_spool_refuses_second_daemon(tmp_path, daemon):
+    from shadow1_tpu.serve.daemon import SpoolError
+
+    d2 = ServeDaemon(daemon.spool.root)
+    with pytest.raises(SpoolError):
+        d2.start()
+
+
+# ---------------------------------------------------------------------------
+# registry / report surfaces
+# ---------------------------------------------------------------------------
+
+def test_serve_registry_and_prometheus():
+    from shadow1_tpu.telemetry.registry import (
+        RECORD_TYPES,
+        REC_SERVE,
+        REC_SERVE_JOB,
+        SERVE_SPECS,
+        to_prometheus,
+    )
+
+    assert REC_SERVE in RECORD_TYPES and REC_SERVE_JOB in RECORD_TYPES
+    text = to_prometheus({"jobs_done": 3, "jobs_queued": 2},
+                         prefix="shadow1_serve", specs=SERVE_SPECS)
+    assert "shadow1_serve_jobs_done_total 3" in text      # counter
+    assert "shadow1_serve_jobs_queued 2" in text          # gauge, no _total
+    assert "shadow1_serve_jobs_queued_total" not in text
+
+
+def test_report_serve_section(capsys):
+    import io
+
+    from shadow1_tpu.tools.heartbeat_report import summarize
+
+    recs = [
+        {"type": "serve", "event": "batch_start", "batch": "b0",
+         "cache": "miss", "lanes": 2},
+        {"type": "serve", "event": "batch_start", "batch": "b1",
+         "cache": "hit", "lanes": 1},
+        {"type": "serve", "event": "evict", "batch": "b0", "jobs": ["a"]},
+        {"type": "serve_job", "job": "a", "state": "queued", "t": 1.0},
+        {"type": "serve_job", "job": "a", "state": "running", "lane": 0,
+         "lanes": 2, "cache": "miss", "t": 2.0},
+        {"type": "serve_job", "job": "a", "state": "evicted", "t": 3.0},
+        {"type": "serve_job", "job": "a", "state": "done", "t": 9.0},
+        {"type": "serve_job", "job": "b", "state": "rejected", "t": 1.0},
+    ]
+    out = io.StringIO()
+    summary = summarize(recs, out=out)
+    s = summary["serve"]
+    assert s["jobs"] == 2 and s["batches"] == 2
+    assert s["cache_hits"] == 1 and s["cache_misses"] == 1
+    assert s["evictions"] == 1
+    text = out.getvalue()
+    assert "serve (daemon job ledger)" in text
+    assert "evicted x1" in text and "wall 8.0s" in text
+
+
+def test_client_exit_taxonomy():
+    assert client.exit_code_for({"state": "done"}) == EXIT_OK
+    assert client.exit_code_for(
+        {"state": "rejected",
+         "error": {"error": "memory_budget"}}) == EXIT_MEMORY
+    assert client.exit_code_for(
+        {"state": "rejected", "error": {"error": "config"}}) == EXIT_CONFIG
+    assert client.exit_code_for(
+        {"state": "failed", "reason": "capacity"}) == EXIT_CAPACITY
+    assert client.exit_code_for(
+        {"state": "failed", "reason": "memory_exhausted"}) == EXIT_MEMORY
+
+
+# ---------------------------------------------------------------------------
+# daemon subprocess: SIGTERM drain + queue persistence (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_daemon_sigterm_drains_and_restart_finishes(tmp_path):
+    spool = str(tmp_path / "spool")
+    cfg = write_cfg(tmp_path, "long.yaml", seed=5, stop=400)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "shadow1_tpu", "serve",
+             "--spool", spool, "--poll-s", "0.05"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True)
+        deadline = time.monotonic() + 60
+        while Spool(spool).daemon_alive() is None:
+            assert p.poll() is None, p.stderr.read()[-800:]
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        return p
+
+    p = spawn()
+    try:
+        jid = client.submit(spool, cfg)
+        # wait until the batch is actually running, then preempt the daemon
+        deadline = time.monotonic() + 120
+        while (Spool(spool).read_status(jid) or {}).get("state") \
+                != "running":
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=120)
+        assert rc == EXIT_SERVE_SHUTDOWN, p.stderr.read()[-800:]
+        # the queue persisted: the drained batch left a resume cursor
+        with open(os.path.join(spool, "queue.json")) as f:
+            q = json.load(f)
+        assert q["queued"] or q["resume"], q
+    finally:
+        if p.poll() is None:
+            p.kill()
+    p = spawn()
+    try:
+        final = client.await_job(Spool(spool), jid, timeout_s=300,
+                                 poll_s=0.1)
+        assert final["state"] == "done", final
+        assert served_stream(spool, jid) == solo_stream(cfg)
+    finally:
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=60) == EXIT_SERVE_SHUTDOWN
