@@ -33,7 +33,11 @@
 #                      rejected pre-compile with advice, served digest
 #                      streams bit-matching solo CLI runs, SIGTERM drain)
 #                      and a kill-during-submit chaos pair (no torn spool
-#                      records, restart completes bit-identically)
+#                      records, restart completes bit-identically); plus
+#                      the flow-probe smokes: the watched-flow probe
+#                      stream on the rung-1 config must be bit-identical
+#                      cpu-vs-tpu, and the flowreport stall detectors
+#                      must pass their synthetic self-test
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -44,7 +48,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py tests/test_serve.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py tests/test_serve.py tests/test_probes.py tests/test_pcap.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -435,6 +439,49 @@ d = json.loads(sys.stdin.read().strip().splitlines()[-1])
 assert d["coverage"] >= 0.9, d
 print("phaseprobe: coverage", d["coverage"], "- rounds",
       d["phases"]["rounds"]["pct"], "% of", d["ms_per_round"], "ms/round")
+'
+    echo "== flow-probe parity smoke (cpu vs tpu) + stall self-test =="
+    # The flow probe plane (docs/SEMANTICS.md §"Flow probe contract"):
+    # the watched-flow stream on the rung-1 TCP config must be
+    # bit-identical between the batched engine's [W,K,F] ring and the
+    # eager oracle's per-boundary mirror, and the watched flow must have
+    # actually moved (an all-zero parity proves nothing).
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import dataclasses
+import shadow1_tpu
+from shadow1_tpu.config.experiment import load_experiment, resolve_watchlist
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+from shadow1_tpu.telemetry.probes import drain_probes
+
+exp, params, _ = load_experiment("configs/rung1_filexfer.yaml")
+watch = resolve_watchlist(["client:0", "server"], exp.dns,
+                          params.sockets_per_host)
+params = dataclasses.replace(params, probes=watch, metrics_ring=64)
+eng = Engine(exp, params)
+st = eng.run(n_windows=40)
+trows = sorted(drain_probes(st, eng.window, watch),
+               key=lambda r: (r["window"], r["host"], r["sock"]))
+ceng = CpuEngine(exp, params)
+ceng.run(n_windows=40)
+crows = sorted(ceng.probe_rows,
+               key=lambda r: (r["window"], r["host"], r["sock"]))
+assert trows == crows, "probe stream diverged cpu vs tpu"
+assert any(r["inflight"] > 0 for r in trows if r["sock"] == 0), \
+    "watched flow never moved"
+print(f"flow probes: {len(trows)} rows bit-identical cpu<->tpu, 40 windows")
+EOF
+    # The stall detectors must flag a synthetic RTO storm and must NOT
+    # flag its clean prefix (false-positive guard) — flowreport's own
+    # self-test.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.flowreport \
+        --selftest | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["selftest"] == "ok", d
+assert "rto_storm" in d["storm_flagged"], d
+assert d["clean_prefix_flagged"] == [], d
+print("flowreport selftest:", d["storm_flagged"], "flagged, clean prefix quiet")
 '
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '

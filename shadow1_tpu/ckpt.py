@@ -59,7 +59,14 @@ import jax
 #      columns, no extra state rides the snapshot beyond the new leaves:
 #      the per-window values are pure boundary samples, so a resumed run's
 #      work-gauge stream continues bit-identically.
-CKPT_FORMAT = 10
+#  11: flow-probe plane — SimState gains the optional ``probes`` ring leaf
+#      ([W,K,F] i64, telemetry/probes.py; fleet: [E,W,K,F]), present only
+#      when EngineParams.probes names watched entities AND metrics_ring > 0.
+#      A probe-less state keeps the v10 leaf layout; the bump makes a
+#      probes-on/probes-off mismatch fail as a version error. Probe rows
+#      are pure window-boundary samples, so a resumed run's flow stream
+#      continues bit-identically (same rule as the digest/work columns).
+CKPT_FORMAT = 11
 
 
 class CorruptCheckpointError(ValueError):

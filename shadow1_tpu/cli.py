@@ -902,6 +902,19 @@ def main(argv=None) -> int:
                          "one per-window JSONL record to stderr per window "
                          "(drained at chunk boundaries; overrides "
                          "engine.metrics_ring from the config)")
+    ap.add_argument("--watch", action="append", default=None,
+                    metavar="HOST[:SOCK]",
+                    help="watch a flow or host (repeatable): sample its "
+                         "state columns (TCP cwnd/ssthresh/srtt/rto/"
+                         "inflight..., NIC backlog/bytes, pending events) "
+                         "at every window boundary into an on-device probe "
+                         "ring, drained as per-window 'flow' JSONL records "
+                         "on stderr (telemetry/probes.py; a ring is enabled "
+                         "automatically on the batched engines). HOST is a "
+                         "config host name (group[i] or group-i for "
+                         "members) or a numeric id; omit :SOCK for the "
+                         "host-level view. Merges with the config's "
+                         "'probes:' section. Render with tools/flowreport.py")
     ap.add_argument("--state-digest", choices=["on", "off"], default=None,
                     metavar="on|off",
                     help="determinism flight recorder (core/digest.py): "
@@ -987,11 +1000,33 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import shadow1_tpu  # noqa: F401  (x64 before jax arrays)
-    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.config.experiment import WatchlistError, load_experiment
 
-    exp, params, scheduler = load_experiment(args.config)
+    try:
+        exp, params, scheduler = load_experiment(args.config)
+    except WatchlistError as e:
+        # Structured config rejection (EXIT_CONFIG via argparse), not a
+        # traced-shape crash deep in the engine: a typo'd probe target is
+        # a config error like any other.
+        ap.error(str(e))
     if args.faults == "off":
         exp.faults = None
+    if args.watch:
+        # CLI watch targets resolve through the SAME path as the config's
+        # 'probes:' section (names via exp.dns), then merge with it —
+        # duplicates collapse, config entries keep first-seen order.
+        import dataclasses
+
+        from shadow1_tpu.config.experiment import resolve_watchlist
+
+        try:
+            extra = resolve_watchlist(list(args.watch), exp.dns,
+                                      params.sockets_per_host)
+        except WatchlistError as e:
+            ap.error(str(e))
+        merged = list(params.probes)
+        merged += [p for p in extra if p not in merged]
+        params = dataclasses.replace(params, probes=tuple(merged))
     if args.metrics_ring is not None:
         import dataclasses
 
@@ -1009,6 +1044,15 @@ def main(argv=None) -> int:
         # heartbeat chunk keeps the drain gap-free). An EXPLICIT
         # --metrics-ring 0 is honored and fails loudly in the engine's
         # state_digest-needs-a-ring check instead.
+        import dataclasses
+
+        params = dataclasses.replace(
+            params, metrics_ring=args.heartbeat or 64)
+    if (params.probes and params.metrics_ring <= 0
+            and args.metrics_ring is None and engine_kind != "cpu"):
+        # Probe rows ride their own [W, K, F] ring but reuse the telemetry
+        # ring's depth knob — same auto-enable rule as --state-digest
+        # (explicit --metrics-ring 0 fails loudly in check_probe_params).
         import dataclasses
 
         params = dataclasses.replace(
@@ -1331,6 +1375,13 @@ def main(argv=None) -> int:
             # ring FLAG stays batched-only: there is no on-device ring
             # here, only its per-window mirror).
             for rec in eng.work_rows:
+                print(json.dumps(rec), file=sys.stderr)
+        if eng.probe_rows:
+            # The oracle's per-window flow-probe stream (REC_FLOW rows) —
+            # the comparand for the batched engines' probe-ring records
+            # (--watch works here directly: no ring needed, the oracle
+            # samples the boundary state straight into rows).
+            for rec in eng.probe_rows:
                 print(json.dumps(rec), file=sys.stderr)
     else:
         import jax

@@ -272,13 +272,108 @@ def _gen_bitcoin_cfg(model_cfg: dict, h: int, seed: int) -> None:
         model_cfg["tx_time"] = (start + np.arange(count) * interval).astype(np.int64)
 
 
+class WatchlistError(ValueError):
+    """A probe watchlist entry (``probes:`` section / ``--watch``) failed to
+    resolve: unknown host/group name, bad index, or out-of-range socket.
+    Raised at CONFIG time — the CLI maps it onto the standard structured
+    config error path (``ap.error`` → EXIT_CONFIG) so a typo'd target can
+    never reach the traced engine as a shape crash."""
+
+
+def _resolve_probe_host(spec, dns) -> int:
+    """One watchlist host spec → global host id.
+
+    Accepted forms: an int host id; ``"name"`` / ``"@name"`` (any hostname
+    or group name the Dns registry knows — bare group name = its first
+    host); ``"name[i]"`` (the group's i-th host, via the registry's
+    ``name-i`` convention)."""
+    txt = str(spec).strip()
+    if isinstance(spec, int) or txt.lstrip("-").isdigit():
+        hid = int(txt)
+        if not 0 <= hid < len(dns):
+            raise WatchlistError(
+                f"probe host id {hid} out of range (hosts 0..{len(dns) - 1})")
+        return hid
+    name = txt[1:] if txt.startswith("@") else txt
+    if name.endswith("]") and "[" in name:
+        base, _, idx_s = name[:-1].partition("[")
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            raise WatchlistError(
+                f"probe target {txt!r}: index {idx_s!r} is not an integer"
+            ) from None
+        name = base if (idx == 0 and f"{base}-0" not in dns._by_name) \
+            else f"{base}-{idx}"
+    try:
+        return dns.resolve(name)
+    except KeyError:
+        import difflib
+
+        close = difflib.get_close_matches(name, dns._by_name, n=3)
+        hint = f" — did you mean {', '.join(map(repr, close))}?" if close \
+            else ""
+        raise WatchlistError(
+            f"unknown probe target {txt!r}: no host or group by that "
+            f"name{hint}") from None
+
+
+def resolve_watchlist(entries, dns, sockets_per_host: int) -> tuple:
+    """Watchlist specs → the EngineParams.probes tuple of (host, sock) ints.
+
+    ``entries`` come from the ``probes:`` config section (list of
+    ``"host[:sock]"`` strings, int ids, or ``{host:, sock:}`` dicts) or
+    repeated ``--watch`` flags. sock defaults to −1 (the host-only
+    NIC/event view). Duplicates collapse, first occurrence wins the order.
+    Every failure raises WatchlistError with a typo-grade message."""
+    if isinstance(entries, (str, int, dict)):
+        entries = [entries]
+    if not isinstance(entries, (list, tuple)):
+        raise WatchlistError(
+            f"probes: must be a list of host[:sock] targets, "
+            f"got {type(entries).__name__}")
+    probes: list[tuple[int, int]] = []
+    for e in entries:
+        if isinstance(e, dict):
+            unknown = set(e) - {"host", "sock"}
+            if unknown:
+                raise WatchlistError(
+                    f"unknown probe entry keys {sorted(map(str, unknown))} "
+                    f"(allowed: host, sock)")
+            if "host" not in e:
+                raise WatchlistError(f"probe entry {e!r} is missing 'host'")
+            spec, sock_s = e["host"], e.get("sock", -1)
+        else:
+            txt = str(e)
+            spec, sep, tail = txt.rpartition(":")
+            if not sep:
+                spec, sock_s = txt, -1
+            else:
+                sock_s = tail
+        host = _resolve_probe_host(spec, dns)
+        try:
+            sock = int(sock_s)
+        except (TypeError, ValueError):
+            raise WatchlistError(
+                f"probe target {e!r}: socket {sock_s!r} is not an integer"
+            ) from None
+        if not -1 <= sock < sockets_per_host:
+            raise WatchlistError(
+                f"probe target {e!r}: socket {sock} out of range "
+                f"(-1 = host view, else 0..{sockets_per_host - 1})")
+        pr = (host, sock)
+        if pr not in probes:
+            probes.append(pr)
+    return tuple(probes)
+
+
 def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment, EngineParams, str]:
     """YAML document → (CompiledExperiment, EngineParams, scheduler)."""
     import os
 
     _reject_unknown("top-level config", doc,
                     ("general", "engine", "network", "hosts", "app",
-                     "faults", "sweep"))
+                     "faults", "sweep", "probes"))
     # ``sweep:`` belongs to fleet mode (shadow1_tpu/fleet/expand.py): a solo
     # run of a sweep config runs the BASE experiment; its section schema is
     # validated there, at --fleet expansion time.
@@ -293,6 +388,13 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
     fields = {f.name: f for f in dataclasses.fields(EngineParams)}
     unknown = set(eng) - set(fields)
     assert not unknown, f"unknown engine params: {unknown}"
+    # The probe watchlist is NOT an engine: knob — it needs host-name
+    # resolution (the top-level ``probes:`` section / --watch own it), and
+    # the scalar coercion below would mangle the target list anyway.
+    assert "probes" not in eng, (
+        "engine.probes is not settable — use the top-level 'probes:' "
+        "section (host[:sock] targets) or the --watch flag"
+    )
     # Coerce by the DECLARED field type (a quoted "256" in YAML must still
     # become an int; only genuinely-str fields like pop_extract stay str).
     params = EngineParams(**{
@@ -427,6 +529,16 @@ def build_experiment(doc: dict, base_dir: str = ".") -> tuple[CompiledExperiment
         dns=Dns.from_groups(groups, host_vertex),
     )
     exp.validate()
+    # -- probes ------------------------------------------------------------
+    # Flow-probe watchlist: resolved through the same name registry app
+    # references use, landing as static (host, sock) int pairs in
+    # EngineParams.probes (telemetry/probes.py samples them per window).
+    watch = doc.get("probes")
+    if watch is not None:
+        params = dataclasses.replace(
+            params,
+            probes=resolve_watchlist(watch, exp.dns,
+                                     params.sockets_per_host))
     return exp, params, scheduler
 
 

@@ -218,6 +218,15 @@ class EngineParams:
     # paces on outbox_space), so changing it mid-run changes the event
     # stream — see tune.autocap.CapPolicy.tune_outbox.
     auto_caps: int = 0
+    # Flow-probe watchlist (telemetry/probes.py): K (host, sock) pairs whose
+    # state columns are sampled once per window into the [W, K, F] probe
+    # ring (W = metrics_ring depth). host is a GLOBAL host id; sock == -1
+    # means the host-only (NIC/event) view. Resolved from the ``probes:``
+    # config section / --watch through config/experiment.resolve_watchlist
+    # — NEVER set raw names here; entries must be ints by trace time (they
+    # are static jit arguments). () (default) = off: no probe leaf rides
+    # SimState and zero probe ops are traced, the --state-digest rule.
+    probes: tuple = ()
     # Determinism flight recorder (core/digest.py): 1 = compute per-window
     # order-independent state digests (one word per subsystem: evbuf,
     # outbox, tcp, nic, rng counters) inside the jitted window loop and
@@ -295,6 +304,14 @@ class EngineParams:
         assert self.pop_extract in ("sum", "gather"), self.pop_extract
         assert self.metrics_ring >= 0, self.metrics_ring
         assert self.state_digest in (0, 1), self.state_digest
+        assert isinstance(self.probes, tuple), (
+            "probes must be a tuple of (host, sock) int pairs "
+            "(resolve_watchlist builds it)")
+        for pr in self.probes:
+            assert (isinstance(pr, tuple) and len(pr) == 2
+                    and all(isinstance(v, int) for v in pr)), pr
+            assert 0 <= pr[0], pr
+            assert -1 <= pr[1] < self.sockets_per_host, pr
         assert self.auto_caps >= 0, self.auto_caps
         assert self.on_overflow in ("drop", "retry", "halt"), self.on_overflow
         assert self.on_lane_fail in ("halt", "quarantine"), self.on_lane_fail

@@ -143,6 +143,10 @@ class SimState(NamedTuple):
     # EngineParams.metrics_ring == 0 — None contributes no pytree leaves,
     # so a ring-less state keeps the historic leaf layout.
     telem: Any = None
+    # Flow-probe ring (telemetry/probes.ProbeRing, [W, K, F]) or None when
+    # EngineParams.probes is empty — same None-leaf rule as the telemetry
+    # ring, so a probe-less state keeps the historic layout.
+    probes: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -520,7 +524,7 @@ def window_frame(st: SimState, ctx: Ctx) -> WindowFrame:
 
 
 def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
-                  make_handlers=None, telem_reduce=None):
+                  make_handlers=None, telem_reduce=None, probe_reduce=None):
     """The ordered (name, frame → frame) stage list of one window.
 
     The phase decomposition of the jitted ``window_step`` (performance
@@ -665,6 +669,19 @@ def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
                 st.telem, fr.m_entry, st.metrics, ev_fill, telem_reduce,
                 digests=digests,
             ))
+        if st.probes is not None:
+            # Flow-probe samples: the same post-delivery window-boundary
+            # state the digests hash, gathered per watched entity
+            # (telemetry/probes.py). ``fr.win_end`` anchors the NIC backlog
+            # columns; the window-entry metrics pick the ring slot.
+            # ``probe_reduce`` psums the owned-shard one-hot rows under
+            # sharding; identity elsewhere.
+            from shadow1_tpu.telemetry.probes import probe_record, probe_sample
+
+            row = probe_sample(st, ctx, fr.win_end, ctx.params.probes)
+            if probe_reduce is not None:
+                row = probe_reduce(row)
+            st = st._replace(probes=probe_record(st.probes, fr.m_entry, row))
         return fr._replace(st=st)
 
     return [("prepare", ph_prepare), ("rounds", ph_rounds),
@@ -673,7 +690,7 @@ def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
 
 def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
                 pre_window=None, make_handlers=None,
-                telem_reduce=None) -> SimState:
+                telem_reduce=None, probe_reduce=None) -> SimState:
     """One conservative window: inner rounds to quiescence, then delivery.
 
     The batched form of the reference's barrier round
@@ -700,7 +717,7 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     carry them as spans)."""
     fr = window_frame(st, ctx)
     for name, fn in window_phases(ctx, handlers, exchange, pre_window,
-                                  make_handlers, telem_reduce):
+                                  make_handlers, telem_reduce, probe_reduce):
         with jax.named_scope(f"phase:{name}"):
             fr = fn(fr)
     return fr.st
@@ -820,6 +837,18 @@ def check_digest_params(params: EngineParams) -> None:
         )
 
 
+def check_probe_params(params: EngineParams) -> None:
+    """The probe ring reuses the telemetry ring's depth knob: watched
+    flows need metrics_ring > 0 on the batched engines (the CPU oracle
+    keeps its own probe_rows and has no ring)."""
+    if params.probes and params.metrics_ring <= 0:
+        raise ValueError(
+            "probes require metrics_ring > 0 on the batched engines — "
+            "the [W, K, F] probe ring depth is the metrics_ring window "
+            "count (CLI --watch sets a ring automatically)"
+        )
+
+
 def _model_module(name: str):
     if name == "phold":
         from shadow1_tpu.core import phold
@@ -868,6 +897,7 @@ class Engine:
         self.exp = exp
         self.params = params or EngineParams()
         check_digest_params(self.params)
+        check_probe_params(self.params)
         self.params = _resolve_kernel_impls(self.params, exp.n_hosts)
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
@@ -900,6 +930,7 @@ class Engine:
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
         evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
@@ -913,6 +944,7 @@ class Engine:
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
+            probes=probe_init(self.params.metrics_ring, self.params.probes),
         )
 
     def place_state(self, st: SimState) -> SimState:

@@ -158,6 +158,14 @@ class CpuEngine:
         # compare bit-exactly against the batched engines.
         self.work_on = self.params.metrics_ring > 0
         self.work_rows: list[dict] = []
+        # Flow-probe plane (telemetry/probes.py): the oracle samples the
+        # same watched (host, sock) columns at every window boundary as the
+        # batched engines' probe ring, into JSONL-ready REC_FLOW dicts.
+        # i32-semantics columns mask with & 0xFFFFFFFF (the u32 widen's
+        # twin); inflight stays the signed seq distance. No ring needed —
+        # rows accumulate directly.
+        self.probe_on = bool(self.params.probes)
+        self.probe_rows: list[dict] = []
         self._work_pending: dict[int, dict] = {}  # window → open row
         self._ob_hosts: dict[int, int] = {}       # window → distinct senders
         self._work_next_open = 0                  # next window to sample
@@ -356,7 +364,7 @@ class CpuEngine:
         fill = int(self.pending.max()) if self.pending.size else 0
         if fill > self.metrics["ev_max_fill"]:
             self.metrics["ev_max_fill"] = fill
-        if not self.digest_on and not self.work_on:
+        if not self.digest_on and not self.work_on and not self.probe_on:
             n_skipped = (upto - self._next_boundary) // self.window + 1
             self._next_boundary += n_skipped * self.window
             self._apply_restarts_pending(upto)
@@ -387,6 +395,8 @@ class CpuEngine:
                     "dg_nic": dg_nic,
                     "dg_rng": dg_rng,
                 })
+            if self.probe_on:
+                self._probe_sample(b, w)
             if self.work_on:
                 self._work_close(w)
             self._next_boundary += self.window
@@ -469,6 +479,48 @@ class CpuEngine:
 
             check_boundary_identity(
                 self.metrics, where=f"window {w} boundary (cpu oracle)")
+
+    def _probe_sample(self, b: int, w: int) -> None:
+        """One REC_FLOW row per watched (host, sock) at boundary ``b`` —
+        the oracle twin of telemetry/probes.probe_sample. i32-semantics TCP
+        columns mask with & 0xFFFFFFFF (the batched engines widen the same
+        i32 planes through u32); ``inflight`` is the signed seq distance;
+        NIC backlog is free-time relative to the boundary."""
+        from shadow1_tpu.consts import SEC, seq_sub
+        from shadow1_tpu.telemetry.registry import PROBE_FIELDS, REC_FLOW
+
+        model = self.model
+        has_net = hasattr(model, "socks")
+        t = round(b / SEC, 9)
+        m32 = 0xFFFFFFFF
+        for gh, sock in self.params.probes:
+            cols = dict.fromkeys(PROBE_FIELDS, 0)
+            if has_net and sock >= 0:
+                k = model.socks[gh][sock]
+                cols["tcp_state"] = k.st & m32
+                cols["cwnd"] = k.cwnd & m32
+                cols["ssthresh"] = k.ssthresh & m32
+                cols["snd_max"] = k.snd_max & m32
+                cols["peer_wnd"] = k.peer_wnd & m32
+                cols["inflight"] = seq_sub(k.snd_nxt, k.snd_una)
+                cols["srtt"] = int(k.srtt)
+                cols["rttvar"] = int(k.rttvar)
+                cols["rto"] = int(k.rto)
+            if has_net:
+                cols["nic_tx_backlog_ns"] = max(int(model.tx_free[gh]) - b, 0)
+                cols["nic_rx_backlog_ns"] = max(int(model.rx_free[gh]) - b, 0)
+                cols["nic_tx_bytes"] = int(model.tx_bytes[gh])
+                cols["nic_rx_bytes"] = int(model.rx_bytes[gh])
+            cols["pending_events"] = int(self.pending[gh])
+            rec = {
+                "type": REC_FLOW,
+                "window": w,
+                "sim_time_s": t,
+                "host": int(gh),
+                "sock": int(sock),
+            }
+            rec.update({f: int(cols[f]) for f in PROBE_FIELDS})
+            self.probe_rows.append(rec)
 
     def _digest_planes(self) -> tuple[int, int, int]:
         """(dg_tcp, dg_nic, dg_rng) of the CURRENT state — the oracle twins

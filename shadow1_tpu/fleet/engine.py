@@ -59,6 +59,7 @@ from shadow1_tpu.core.engine import (
     _model_module,
     build_base_ctx,
     check_digest_params,
+    check_probe_params,
     window_step,
 )
 from shadow1_tpu.core.events import evbuf_init
@@ -122,6 +123,33 @@ def drain_fleet_rings(st: SimState, window_ns: int, start: int = 0,
         )
         gid = exp_ids[e] if exp_ids is not None else e + exp_base
         for r in drain_ring(lane, window_ns, start=start):
+            recs.append({**r, "exp": int(gid)})
+    return recs
+
+
+def drain_fleet_probes(st: SimState, window_ns: int, probes: tuple,
+                       start: int = 0, exp_base: int = 0,
+                       exp_ids=None) -> list[dict]:
+    """Per-experiment probe-ring drain: the solo ``drain_probes`` per lane
+    over the [E, W, K, F] fleet ring, each ``flow`` record tagged with its
+    sweep-global experiment id (``exp``) — same id rules and same
+    two-fetch-then-numpy-views discipline as ``drain_fleet_rings``."""
+    from types import SimpleNamespace
+
+    from shadow1_tpu.telemetry.probes import drain_probes
+
+    if getattr(st, "probes", None) is None:
+        return []
+    buf = np.asarray(st.probes.buf)              # [E, W, K, F]
+    windows = np.asarray(st.metrics.windows)     # [E]
+    recs: list[dict] = []
+    for e in range(buf.shape[0]):
+        lane = SimpleNamespace(
+            probes=SimpleNamespace(buf=buf[e]),
+            metrics=SimpleNamespace(windows=int(windows[e])),
+        )
+        gid = exp_ids[e] if exp_ids is not None else e + exp_base
+        for r in drain_probes(lane, window_ns, probes, start=start):
             recs.append({**r, "exp": int(gid)})
     return recs
 
@@ -213,6 +241,7 @@ class FleetEngine:
         self.params = params or EngineParams()
         check_uniform(exps, [self.params] * len(exps))
         check_digest_params(self.params)
+        check_probe_params(self.params)
         self.params = self._resolve_fleet_params(self.params)
         self.exps = list(exps)
         self.exp = exps[0]
@@ -343,6 +372,7 @@ class FleetEngine:
 
     # -- state -------------------------------------------------------------
     def _lane_init_state(self, var: dict) -> SimState:
+        from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
         ctx = self._lane_ctx(var)
@@ -358,6 +388,7 @@ class FleetEngine:
                 ev_overflow=metrics.ev_overflow + seed_over),
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
+            probes=probe_init(self.params.metrics_ring, self.params.probes),
         )
 
     def init_state(self) -> SimState:
@@ -517,9 +548,13 @@ class FleetEngine:
         return out
 
     def drain_rings(self, st: SimState, start: int = 0) -> list[dict]:
-        return drain_fleet_rings(st, self.window, start=start,
+        recs = drain_fleet_rings(st, self.window, start=start,
                                  exp_base=self.exp_base,
                                  exp_ids=self.exp_ids)
+        recs += drain_fleet_probes(st, self.window, self.params.probes,
+                                   start=start, exp_base=self.exp_base,
+                                   exp_ids=self.exp_ids)
+        return recs
 
     @staticmethod
     def lane_done(st: SimState) -> np.ndarray:

@@ -69,8 +69,11 @@ class Heartbeat:
         )
         # First ring window still undrained (resume-aware like ``last``).
         self._ring_next: int = self.last.get("windows", 0)
+        # Same cursor for the flow-probe ring (telemetry/probes.py).
+        self._probe_next: int = self.last.get("windows", 0)
         self.records: list[dict] = []
         self.ring_records: list[dict] = []
+        self.flow_records: list[dict] = []
 
     def _emit(self, rec: dict) -> None:
         if self.stream:
@@ -82,6 +85,7 @@ class Heartbeat:
         with maybe_span(self.profiler, PH_DRAIN):
             m = normalize(_metrics_mapping(st.metrics))
             ring_recs = self._drain_ring(st)
+            flow_recs = self._drain_probes(st)
         delta = {k: v - self.last.get(k, 0) for k, v in m.items()}
         dt = now - self.t_last
         sim_ns = int(st.win_start)  # the true sim clock (resume-aware)
@@ -181,6 +185,10 @@ class Heartbeat:
             self.ring_records.append(r)
             if self.emit_ring:
                 self._emit(r)
+        for r in flow_recs:
+            self.flow_records.append(r)
+            if self.emit_ring:
+                self._emit(r)
         self.t_last = now
         self.last = m
 
@@ -192,6 +200,19 @@ class Heartbeat:
 
         recs = drain_ring(st, self.engine.window, start=self._ring_next)
         self._ring_next = int(st.metrics.windows)
+        return recs
+
+    def _drain_probes(self, st) -> list[dict]:
+        """Per-window flow-probe rows since the last chunk boundary (solo
+        engines; the fleet engine's drain_rings handles its [E,...] ring)."""
+        if getattr(st, "probes", None) is None:
+            return []
+        from shadow1_tpu.telemetry.probes import drain_probes
+
+        probes = getattr(getattr(self.engine, "params", None), "probes", ())
+        recs = drain_probes(st, self.engine.window, probes,
+                            start=self._probe_next)
+        self._probe_next = int(st.metrics.windows)
         return recs
 
 

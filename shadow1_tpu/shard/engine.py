@@ -95,9 +95,11 @@ class ShardedEngine:
         exp.validate()
         self.exp = exp
         self.params = params or EngineParams()
-        from shadow1_tpu.core.engine import check_digest_params
+        from shadow1_tpu.core.engine import (check_digest_params,
+                                             check_probe_params)
 
         check_digest_params(self.params)
+        check_probe_params(self.params)
         devices = list(devices if devices is not None else jax.devices())
         self.n_dev = len(devices)
         if exp.n_hosts % self.n_dev:
@@ -168,13 +170,22 @@ class ShardedEngine:
         # win_start (window_step globalizes each row via telem_reduce).
         # Spec'd explicitly so a ring whose trailing dim happens to equal
         # n_hosts can never be mis-sharded by the shape heuristic.
-        specs = jax.tree.map(self._spec_for, st._replace(telem=None))
+        specs = jax.tree.map(self._spec_for, st._replace(telem=None,
+                                                         probes=None))
         if st.telem is not None:
             specs = specs._replace(telem=jax.tree.map(lambda _: P(), st.telem))
+        # The probe ring is [W, K, F] — replicated for the same reason (the
+        # one-hot psum in probe_reduce makes every shard carry the owning
+        # shard's rows), and spec'd explicitly for the same shape-collision
+        # safety.
+        if st.probes is not None:
+            specs = specs._replace(
+                probes=jax.tree.map(lambda _: P(), st.probes))
         return specs
 
     # -- state -------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
         evbuf = evbuf_init(self.exp.n_hosts, self.params.ev_cap)
@@ -188,6 +199,7 @@ class ShardedEngine:
             metrics=metrics._replace(ev_overflow=metrics.ev_overflow + seed_over),
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
+            probes=probe_init(self.params.metrics_ring, self.params.probes),
         )
         return self.place_state(st)
 
@@ -378,12 +390,21 @@ class ShardedEngine:
                 # yields the exact single-device digest on every shard.
                 return jax.lax.psum(counters, axis), pmax_(gauges)
 
+            def probe_reduce(row):
+                # Globalize one [K, F] probe row: probe_sample zeroes every
+                # probe another shard's block owns, so the psum IS the
+                # owning shard's row — every shard then carries the
+                # identical replicated ring (same one-hot-sum trick as
+                # pmax_, sum-only collectives).
+                return jax.lax.psum(row, axis)
+
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
                 0, n_windows,
                 lambda _, s: window_step(s, ctx, handlers, exchange, pre_window,
                                          make_handlers=model.make_handlers,
-                                         telem_reduce=telem_reduce),
+                                         telem_reduce=telem_reduce,
+                                         probe_reduce=probe_reduce),
                 st,
             )
             # Each shard accumulated its own partials on top of the (replicated)

@@ -1,0 +1,182 @@
+"""On-device flow-probe ring — per-window state samples of watched entities.
+
+The telemetry ring (telemetry/ring.py) sees the ENGINE (counter deltas,
+occupancy gauges); it cannot answer "what did flow (host 3, sock 0) do" —
+per-flow TCP dynamics and per-NIC queue state were only reachable by
+re-running on the CPU oracle. This module gives the batched engines the
+reference Tracker's per-socket fidelity (src/main/host/tracker.c) without
+breaking the zero-mid-window-host-sync contract:
+
+* ``EngineParams.probes`` holds K watched (host, sock) pairs — resolved at
+  config time (config/experiment.resolve_watchlist) so they are static
+  Python ints by the time anything traces;
+* a device-resident ``[W, K, F]`` i64 buffer rides in ``SimState.probes``
+  beside the telemetry ring; at the end of every conservative window the
+  engine gathers each probe's state columns (``registry.PROBE_FIELDS``
+  order) and writes one [K, F] row at slot ``window % W`` — one
+  dynamic_update_slice, entirely inside the jitted loop;
+* at chunk boundaries the host drains the rows into JSONL ``flow`` records
+  (``drain_probes``); overwritten windows are reported as one ``flow_gap``
+  record, exactly like ``ring_gap``.
+
+The samples are window-BOUNDARY state — the same engine-independent sets
+the state digest hashes — so the CPU oracle mirrors them bit-exactly
+(cpu_engine/engine.py probe_rows), each shard of a sharded run contributes
+its owned probes through a one-hot psum (every shard then carries the
+identical replicated ring), and fleet lanes vmap to [E, W, K, F] with
+exp-tagged records. Probes default off: ``probe_init`` returns None, no
+pytree leaf exists, and the traced program is bit-identical to a
+probe-less build (the ``--state-digest`` rule).
+
+i32-semantics columns (TCP sequence/window fields) widen via u32 so the
+TPU's natural i32 wraparound and the oracle's masked Python ints compare
+equal; ``inflight`` is the one SIGNED column (seq distance snd_nxt −
+snd_una, computed in i32 then widened).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu.consts import SEC
+from shadow1_tpu.telemetry.registry import (
+    PROBE_FIELDS,
+    REC_FLOW,
+    REC_FLOW_GAP,
+)
+
+
+class ProbeRing(NamedTuple):
+    """The device-resident probe ring: one [K, F] row per window."""
+
+    buf: jnp.ndarray  # i64 [W, K, len(PROBE_FIELDS)]
+
+
+def probe_init(n_windows: int, probes: tuple) -> ProbeRing | None:
+    """A W-row probe ring for K watched entities, or None when disabled.
+
+    None (no probes, or no ring depth) contributes no pytree leaf, so a
+    probe-less state keeps the historic leaf layout — checkpoints and
+    sharding specs are unaffected unless probes are actually on."""
+    if n_windows <= 0 or not probes:
+        return None
+    return ProbeRing(
+        buf=jnp.zeros((int(n_windows), len(probes), len(PROBE_FIELDS)),
+                      jnp.int64)
+    )
+
+
+def _u32w(v):
+    """i32 plane value → i64 through the u32 window (the i32-semantics
+    rule: the oracle masks with & 0xFFFFFFFF; a negative i32 here is the
+    same wrapped u32)."""
+    return v.astype(jnp.uint32).astype(jnp.int64)
+
+
+def probe_sample(st, ctx, win_end, probes: tuple) -> jnp.ndarray:
+    """Gather the [K, F] boundary sample of every watched entity (traced).
+
+    ``probes`` are (global_host, sock) int pairs, sock == −1 for the
+    host-only view. Probes owned by another shard's block contribute 0 in
+    every column — the sharded engine's one-hot psum then reconstructs the
+    owner's row exactly (shard/engine.py probe_reduce)."""
+    n_hosts = ctx.n_hosts
+    base = ctx.hosts[0]
+    model = st.model
+    mf = getattr(model, "_fields", ())
+    has_net = "nic" in mf and "tcp" in mf
+    from shadow1_tpu.core.events import tb_join
+
+    live = st.evbuf.kind != 0  # K_NONE
+    rows = []
+    for gh, sock in probes:
+        loc = jnp.asarray(gh, jnp.int32) - base
+        owned = (loc >= 0) & (loc < n_hosts)
+        locc = jnp.clip(loc, 0, n_hosts - 1)
+        z = jnp.zeros((), jnp.int64)
+        cols = dict.fromkeys(PROBE_FIELDS, z)
+        if has_net and sock >= 0:
+            tcp = model.tcp
+            cols["tcp_state"] = _u32w(tcp["st"][sock, locc])
+            cols["cwnd"] = _u32w(tcp["cwnd"][sock, locc])
+            cols["ssthresh"] = _u32w(tcp["ssthresh"][sock, locc])
+            cols["snd_max"] = _u32w(tcp["snd_max"][sock, locc])
+            cols["peer_wnd"] = _u32w(tcp["peer_wnd"][sock, locc])
+            # Signed seq distance: i32 subtraction wraps exactly like the
+            # oracle's seq_sub, then the widen preserves the sign.
+            cols["inflight"] = (
+                tcp["snd_nxt"][sock, locc] - tcp["snd_una"][sock, locc]
+            ).astype(jnp.int64)
+            for f in ("srtt", "rttvar", "rto"):
+                cols[f] = tb_join(tcp[f + "_hi"][sock, locc],
+                                  tcp[f + "_lo"][sock, locc])
+        if has_net:
+            nic = model.nic
+            cols["nic_tx_backlog_ns"] = jnp.maximum(
+                nic.tx_free[locc] - win_end, 0)
+            cols["nic_rx_backlog_ns"] = jnp.maximum(
+                nic.rx_free[locc] - win_end, 0)
+            cols["nic_tx_bytes"] = nic.tx_bytes[locc]
+            cols["nic_rx_bytes"] = nic.rx_bytes[locc]
+        cols["pending_events"] = live[:, locc].sum(dtype=jnp.int64)
+        row = jnp.stack([cols[f] for f in PROBE_FIELDS])
+        rows.append(jnp.where(owned, row, 0))
+    return jnp.stack(rows)  # [K, F]
+
+
+def probe_record(pring: ProbeRing, m0, row) -> ProbeRing:
+    """Write one per-window [K, F] row (traced; end of window_step).
+
+    ``m0`` is the window-entry Metrics — its pre-increment ``windows``
+    counter is this window's global ordinal, the ring slot (same rule as
+    ring_record)."""
+    w = pring.buf.shape[0]
+    slot = (m0.windows % w).astype(jnp.int32)
+    z = jnp.zeros((), jnp.int32)
+    return pring._replace(
+        buf=jax.lax.dynamic_update_slice(
+            pring.buf, row[None].astype(jnp.int64), (slot, z, z)
+        )
+    )
+
+
+def drain_probes(st, window_ns: int, probes: tuple,
+                 start: int = 0) -> list[dict]:
+    """Host-side drain: the flow rows for windows [start, windows_done).
+
+    One device→host fetch per call (chunk boundary, never mid-window).
+    Returns JSONL-ready ``flow`` dicts in (window, probe) order; windows
+    overwritten since ``start`` become one ``flow_gap`` record."""
+    pring = getattr(st, "probes", None)
+    if pring is None:
+        return []
+    buf = np.asarray(pring.buf)
+    w = buf.shape[0]
+    done = int(st.metrics.windows)
+    lo = max(start, done - w)
+    recs: list[dict] = []
+    if lo > start:
+        recs.append({
+            "type": REC_FLOW_GAP,
+            "windows_lost": lo - start,
+            "first_window": start,
+            "ring_slots": w,
+        })
+    for win in range(lo, done):
+        rows = buf[win % w]
+        t = round((win + 1) * window_ns / SEC, 9)
+        for k, (gh, sock) in enumerate(probes):
+            rec = {
+                "type": REC_FLOW,
+                "window": win,
+                "sim_time_s": t,
+                "host": int(gh),
+                "sock": int(sock),
+            }
+            rec.update({f: int(v) for f, v in zip(PROBE_FIELDS, rows[k])})
+            recs.append(rec)
+    return recs

@@ -152,11 +152,21 @@ REC_WORK = "work"
 # stay out of ring percentile math by being their own record types.
 REC_SERVE = "serve"
 REC_SERVE_JOB = "serve_job"
+# Flow-probe plane (telemetry/probes.py, EngineParams.probes): ``flow`` =
+# one per-window sample of one watched (host, sock) entity — the PROBE_FIELDS
+# columns plus window/sim_time_s/host/sock (sock −1 = host-only view). The
+# batched engines carry the samples in the [W, K, F] probe ring and drain
+# them at chunk boundaries; the CPU oracle emits the same rows at window
+# boundaries (probe_rows) — bit-identical streams, like the digest words.
+# ``flow_gap`` mirrors ``ring_gap``: windows overwritten before a drain.
+# Fleet rows add the ``exp`` id, same rule as ring records.
+REC_FLOW = "flow"
+REC_FLOW_GAP = "flow_gap"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
                 REC_FLEET_RETRY, REC_FLEET_QUARANTINE,
                 REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK,
-                REC_SERVE, REC_SERVE_JOB)
+                REC_SERVE, REC_SERVE_JOB, REC_FLOW, REC_FLOW_GAP)
 
 # Serve-plane job-ledger namespace (shadow1_tpu/serve/daemon.py): exported
 # on the daemon's Prometheus endpoint (--metrics-port) with the
@@ -244,6 +254,34 @@ RING_DIGESTS = (
                   # + model draw counters)
 )
 RING_FIELDS = RING_COUNTERS + RING_WORK + RING_GAUGES + RING_DIGESTS
+
+# ---------------------------------------------------------------------------
+# Flow-probe column schema (consumed by telemetry/probes.py, which owns the
+# jax side; declared here so report tools stay jax-free). One [K, F] row per
+# window per watched entity, F = len(PROBE_FIELDS), sampled at the window
+# boundary — the same engine-independent boundary state the digest hashes,
+# so cpu/tpu/sharded/fleet streams compare bit-exact. TCP columns are zero
+# for host-only probes (sock == −1) and for non-net models; NIC backlogs are
+# ns of serialization debt relative to the window end (max(free_at − end, 0)).
+# There are no per-host NIC drop counters in NicState (drops are global
+# metrics), so the byte counters carry the per-host wire activity instead.
+# ---------------------------------------------------------------------------
+PROBE_FIELDS = (
+    "tcp_state",          # TCP_* state enum (0 = free/closed)
+    "cwnd",               # congestion window, bytes
+    "ssthresh",           # slow-start threshold, bytes
+    "srtt",               # smoothed RTT, ns (0 until first sample)
+    "rttvar",             # RTT variance, ns
+    "rto",                # retransmit timeout, ns
+    "inflight",           # snd_nxt − snd_una (signed seq distance), bytes
+    "snd_max",            # highest sequence ever sent (u32 window)
+    "peer_wnd",           # last advertised peer receive window, bytes
+    "nic_tx_backlog_ns",  # uplink serialization backlog past window end, ns
+    "nic_rx_backlog_ns",  # downlink serialization backlog past window end, ns
+    "nic_tx_bytes",       # lifetime wire bytes sent by the host
+    "nic_rx_bytes",       # lifetime wire bytes received by the host
+    "pending_events",     # events queued at the host at the boundary
+)
 
 
 def counter_names() -> tuple[str, ...]:

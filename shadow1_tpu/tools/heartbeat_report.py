@@ -25,6 +25,7 @@ from shadow1_tpu.telemetry.registry import (
     REC_FLEET_QUARANTINE,
     REC_FLEET_RETRY,
     REC_FLEET_SUMMARY,
+    REC_FLOW,
     REC_HEARTBEAT,
     REC_LINEAGE,
     REC_MEM,
@@ -565,7 +566,88 @@ def summarize(recs: list[dict], out=None) -> dict:
                 f"pending {r.get('pending_events', 0)}",
                 file=out,
             )
+        intervals = tracker_intervals(tr)
+        if intervals:
+            # Interval deltas between successive tracker snapshots: the
+            # tracker stream carries lifetime absolutes (log.py), so a log
+            # holding several snapshots (one --tracker file per chunk, or
+            # a concatenated series of runs) yields per-host RATES here —
+            # the reference Tracker's interval view.
+            summary["tracker_intervals"] = len(intervals)
+            print("== tracker interval deltas (per-host) ==", file=out)
+            for iv in intervals:
+                print(f"  interval sim_s {iv['from_sim_s']} -> "
+                      f"{iv['to_sim_s']}:", file=out)
+                top = sorted(iv["hosts"].items(),
+                             key=lambda kv: -kv[1].get("nic_tx_bytes", 0)
+                             )[:10]
+                for h, d in top:
+                    parts = "  ".join(f"{k} +{v}" for k, v in d.items()
+                                      if v)
+                    print(f"    host {h}: {parts or '(no change)'}",
+                          file=out)
+    flows_recs = [r for r in recs if r.get("type") == REC_FLOW]
+    if flows_recs:
+        # Flow-probe plane (--watch / probes:): one line per watched
+        # entity with its headline stats and stall findings. The full
+        # series/sparkline view is tools/flowreport.py's job — this
+        # section is the triage index.
+        from shadow1_tpu.tools.flowreport import (
+            _flow_label,
+            diagnose_flow,
+            flow_stats,
+            group_flows,
+        )
+
+        groups = group_flows(flows_recs)
+        fsum: dict = {}
+        print("== flows (probe plane) ==", file=out)
+        for key, rows in groups.items():
+            label = _flow_label(key)
+            stats = flow_stats(rows)
+            stalls = diagnose_flow(rows)
+            fsum[label] = {**stats, "stalls": [s["kind"] for s in stalls]}
+            stall_txt = ("  STALLS: " + ", ".join(s["kind"] for s in stalls)
+                         if stalls else "")
+            print(f"  {label}: windows {stats['windows']}  "
+                  f"state {stats['tcp_state_last']}  "
+                  f"cwnd {stats['cwnd_last']}  "
+                  f"inflight_max {stats['inflight_max']}  "
+                  f"backlog_max {stats['nic_tx_backlog_ns_max']} ns"
+                  f"{stall_txt}", file=out)
+        summary["flows"] = fsum
     return summary
+
+
+def tracker_intervals(tr: list[dict]) -> list[dict]:
+    """Per-host counter deltas between successive tracker snapshots.
+
+    Snapshots are the groups of records sharing one ``sim_s``; counters
+    are every numeric field (cpu_busy_ns, nic bytes, app counters...).
+    ``pending_events`` is a gauge, not a counter — its delta is still the
+    honest "queue grew/shrank by N" signal, so it is kept, sign and all.
+    Returns [] when the log holds fewer than two snapshots."""
+    by_time: dict[float, dict[int, dict]] = {}
+    for r in tr:
+        by_time.setdefault(r.get("sim_s", 0), {})[r.get("host", 0)] = r
+    times = sorted(by_time)
+    out = []
+    skip = ("host", "sim_s")
+    for t0, t1 in zip(times, times[1:]):
+        hosts: dict[int, dict] = {}
+        for h, cur in sorted(by_time[t1].items()):
+            prev = by_time[t0].get(h, {})
+            d = {}
+            for k, v in cur.items():
+                if k in skip or not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    continue
+                p = prev.get(k, 0)
+                if isinstance(p, (int, float)):
+                    d[k] = v - p
+            hosts[h] = d
+        out.append({"from_sim_s": t0, "to_sim_s": t1, "hosts": hosts})
+    return out
 
 
 def write_heartbeat_csv(recs: list[dict], path: str) -> None:

@@ -87,3 +87,45 @@ class PcapWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class FilteredPcap:
+    """Watchlist filter in front of a PcapWriter (tools/pcapdump --host).
+
+    ``watchlist`` is the probe plane's resolved (host, sock) tuple
+    (config/experiment.resolve_watchlist — the same targets --watch
+    accepts): a packet passes when its src OR dst endpoint matches an
+    entry; sock == -1 entries match every socket on the host. An empty
+    watchlist passes everything (filterless pcapdump unchanged).
+    Drop-in for the CpuEngine ``capture`` hook — n_packets counts only
+    what passed, like a capture filter on a real interface."""
+
+    def __init__(self, writer: PcapWriter, watchlist: tuple = ()):
+        self.writer = writer
+        self.watchlist = tuple(watchlist)
+
+    @property
+    def n_packets(self) -> int:
+        return self.writer.n_packets
+
+    def _match(self, host: int, sock: int) -> bool:
+        return any(h == host and (s < 0 or s == sock)
+                   for h, s in self.watchlist)
+
+    def __call__(self, time_ns: int, src: int, dst: int, p: tuple,
+                 dropped: bool) -> None:
+        if self.watchlist:
+            packed = int(p[1])
+            ss, ds = packed & 0xFF, (packed >> 8) & 0xFF
+            if not (self._match(src, ss) or self._match(dst, ds)):
+                return
+        self.writer(time_ns, src, dst, p, dropped)
+
+    def close(self) -> None:
+        self.writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -2,9 +2,14 @@
 capture every delivered packet to a .pcap file.
 
     python -m shadow1_tpu.tools.pcapdump config.yaml out.pcap [--windows N]
+        [--host NAME[:SOCK]]... [--sock N]
 
 The capture engine is the sequential oracle (it sees every packet at
-routing time); for large configs bound the run with --windows.
+routing time); for large configs bound the run with --windows. --host
+narrows the capture to packets touching the named endpoints — targets
+resolve exactly like the probe plane's --watch flag (config host names,
+group[i] / group-i members, numeric ids, optional :SOCK), so the pcap of
+a misbehaving flow and its probe stream point at the same entity.
 """
 
 from __future__ import annotations
@@ -18,18 +23,42 @@ def main(argv=None) -> int:
     ap.add_argument("out")
     ap.add_argument("--windows", type=int, default=None)
     ap.add_argument("--snaplen", type=int, default=128)
+    ap.add_argument("--host", action="append", default=None,
+                    metavar="NAME[:SOCK]",
+                    help="capture only packets whose src or dst matches "
+                         "(repeatable; --watch target syntax — omit :SOCK "
+                         "for every socket on the host)")
+    ap.add_argument("--sock", type=int, default=None, metavar="N",
+                    help="with --host entries that omit :SOCK, narrow them "
+                         "to socket N")
     args = ap.parse_args(argv)
 
     import shadow1_tpu  # noqa: F401
     from shadow1_tpu.platform import force_cpu
 
     force_cpu(1)  # the oracle needs no accelerator
-    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.config.experiment import (
+        WatchlistError,
+        load_experiment,
+        resolve_watchlist,
+    )
     from shadow1_tpu.cpu_engine import CpuEngine
-    from shadow1_tpu.tools.pcap import PcapWriter
+    from shadow1_tpu.tools.pcap import FilteredPcap, PcapWriter
 
-    exp, params, _ = load_experiment(args.config)
-    with PcapWriter(args.out, snaplen=args.snaplen) as w:
+    if args.sock is not None and not args.host:
+        ap.error("--sock narrows --host entries; give at least one --host")
+    try:
+        exp, params, _ = load_experiment(args.config)
+        watchlist: tuple = ()
+        if args.host:
+            entries = [h if (":" in h or args.sock is None)
+                       else f"{h}:{args.sock}" for h in args.host]
+            watchlist = resolve_watchlist(entries, exp.dns,
+                                          params.sockets_per_host)
+    except WatchlistError as e:
+        ap.error(str(e))
+    with FilteredPcap(PcapWriter(args.out, snaplen=args.snaplen),
+                      watchlist) as w:
         eng = CpuEngine(exp, params, capture=w)
         m = eng.run(n_windows=args.windows)
         print(f"{w.n_packets} packets captured to {args.out}; metrics: {m}")

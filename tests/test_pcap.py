@@ -1,0 +1,134 @@
+"""pcap writer golden-bytes tests + watchlist capture filter.
+
+The writer synthesizes real IPv4 + TCP/UDP headers from packet records
+(tools/pcap.py); these tests pin the exact bytes so a header-layout
+regression (endianness, field order, flag mapping, snaplen math) shows up
+as a byte diff, not as "wireshark renders it oddly".
+"""
+
+import struct
+
+from shadow1_tpu.consts import F_ACK, F_DGRAM, F_FIN, F_RST, F_SYN
+from shadow1_tpu.tools.pcap import FilteredPcap, PcapWriter
+
+M32 = 0xFFFFFFFF
+
+
+def _pkt(ss=0, ds=0, flags=0, seq=0, ack=0, length=0, wnd=0):
+    # The oracle's packet tuple: p[1] packs (ss, ds, flags), then
+    # seq / ack / payload-length / advertised-window.
+    return (0, ss | (ds << 8) | (flags << 16), seq, ack, length, wnd)
+
+
+def _capture(tmp_path, calls, snaplen=128):
+    path = str(tmp_path / "t.pcap")
+    with PcapWriter(path, snaplen=snaplen) as w:
+        for c in calls:
+            w(*c)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _frames(data):
+    """Split a pcap byte string into (per-packet header, frame) pairs."""
+    assert struct.unpack_from("<IHHiIII", data, 0)[0] == 0xA1B2C3D4
+    out, off = [], 24
+    while off < len(data):
+        hdr = struct.unpack_from("<IIII", data, off)
+        off += 16
+        out.append((hdr, data[off:off + hdr[2]]))
+        off += hdr[2]
+    return out
+
+
+def test_syn_packet_golden_bytes(tmp_path):
+    data = _capture(tmp_path, [
+        (1_500_000_000, 1, 0, _pkt(ss=2, ds=3, flags=F_SYN, seq=7,
+                                   wnd=65535), False),
+    ])
+    assert data[:24] == struct.pack(
+        "<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 128, 101)
+    (hdr, frame), = _frames(data)
+    ts_sec, ts_usec, incl, orig = hdr
+    assert (ts_sec, ts_usec) == (1, 500000)  # 1.5 s, ns → µs
+    assert incl == orig == 40                # 20 IP + 20 TCP, no payload
+    ip = struct.pack(">BBHHHBBH", 0x45, 0, 40, 0, 0, 64, 6, 0) \
+        + bytes([10, 0, 0, 1]) + bytes([10, 0, 0, 0])
+    tcp = struct.pack(">HHIIBBHHH", 10002, 10003, 7, 0, 5 << 4,
+                      0x02, 65535, 0, 0)
+    assert frame == ip + tcp
+
+
+def test_fin_ack_and_rst_flag_mapping(tmp_path):
+    data = _capture(tmp_path, [
+        (0, 0, 1, _pkt(flags=F_FIN | F_ACK, seq=9, ack=10), False),
+        (0, 1, 0, _pkt(flags=F_RST), False),
+    ])
+    (_, fin_frame), (_, rst_frame) = _frames(data)
+    # TCP flags byte is offset 13 in the 20-byte header after 20 bytes IP.
+    assert fin_frame[20 + 13] == 0x01 | 0x10
+    assert rst_frame[20 + 13] == 0x04
+    assert struct.unpack_from(">I", fin_frame, 20 + 4)[0] == 9
+    assert struct.unpack_from(">I", fin_frame, 20 + 8)[0] == 10
+
+
+def test_seq_ack_wrap_to_u32(tmp_path):
+    data = _capture(tmp_path, [
+        (0, 0, 1, _pkt(seq=(1 << 32) + 5, ack=-1 & M32, flags=F_ACK), False),
+    ])
+    (_, frame), = _frames(data)
+    assert struct.unpack_from(">I", frame, 20 + 4)[0] == 5
+    assert struct.unpack_from(">I", frame, 20 + 8)[0] == M32
+
+
+def test_dgram_is_udp(tmp_path):
+    data = _capture(tmp_path, [
+        (2_000, 4, 5, _pkt(ss=1, ds=2, flags=F_DGRAM, length=100), False),
+    ])
+    (hdr, frame), = _frames(data)
+    assert frame[9] == 17  # IP protocol = UDP
+    sport, dport, ulen, csum = struct.unpack_from(">HHHH", frame, 20)
+    assert (sport, dport, ulen, csum) == (10001, 10002, 108, 0)
+    assert hdr[3] == 20 + 8 + 100  # orig_len carries the payload
+
+
+def test_snaplen_truncation(tmp_path):
+    data = _capture(tmp_path, [
+        (0, 0, 1, _pkt(length=1000), False),
+    ], snaplen=64)
+    (hdr, frame), = _frames(data)
+    _, _, incl, orig = hdr
+    assert orig == 20 + 20 + 1000
+    assert incl == 64 and len(frame) == 64
+    # Payload is zero padding beyond the real headers.
+    assert frame[40:] == b"\x00" * 24
+
+
+def test_dropped_packets_are_skipped(tmp_path):
+    data = _capture(tmp_path, [
+        (0, 0, 1, _pkt(), True),
+        (0, 0, 1, _pkt(), False),
+    ])
+    assert len(_frames(data)) == 1
+
+
+def test_filtered_pcap_watchlist(tmp_path):
+    path = str(tmp_path / "f.pcap")
+    # Watch host 3's socket 0 and all of host 7.
+    with FilteredPcap(PcapWriter(path), ((3, 0), (7, -1))) as w:
+        w(0, 3, 1, _pkt(ss=0, ds=2), False)   # src match (3, sock 0)
+        w(0, 3, 1, _pkt(ss=1, ds=2), False)   # src host 3 but sock 1: no
+        w(0, 1, 3, _pkt(ss=5, ds=0), False)   # dst match (3, sock 0)
+        w(0, 7, 1, _pkt(ss=9, ds=0), False)   # host 7 matches any sock
+        w(0, 1, 2, _pkt(), False)             # unrelated
+        assert w.n_packets == 3
+    with open(path, "rb") as f:
+        assert len(_frames(f.read())) == 3
+
+
+def test_filtered_pcap_empty_watchlist_passes_all(tmp_path):
+    path = str(tmp_path / "all.pcap")
+    with FilteredPcap(PcapWriter(path), ()) as w:
+        w(0, 0, 1, _pkt(), False)
+        w(0, 5, 6, _pkt(), False)
+        assert w.n_packets == 2

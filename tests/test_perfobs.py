@@ -70,8 +70,9 @@ def test_work_schema_and_ckpt_format():
     assert set(RING_WORK) <= set(Metrics._fields)
     assert RING_FIELDS == RING_COUNTERS + RING_WORK + RING_GAUGES + \
         RING_DIGESTS
-    # Widened ring row + new Metrics leaves = snapshot layout change.
-    assert CKPT_FORMAT == 10
+    # Widened ring row + new Metrics leaves = snapshot layout change
+    # (v10); the flow-probe ring leaf bumped it again (v11).
+    assert CKPT_FORMAT == 11
 
 
 def test_stale_ckpt_format_rejected(tmp_path):
@@ -83,9 +84,10 @@ def test_stale_ckpt_format_rejected(tmp_path):
     ckpt.save_state(st, path)
     with np.load(path) as d:
         arrs = {k: d[k].copy() for k in d.files}
-    arrs["format"][0] = ckpt.CKPT_FORMAT - 1  # a pre-work-gauge snapshot
+    arrs["format"][0] = ckpt.CKPT_FORMAT - 1  # the previous layout
     np.savez(path, **arrs)
-    with pytest.raises(ValueError, match="format v9.*reads v10"):
+    with pytest.raises(ValueError, match=f"format v{ckpt.CKPT_FORMAT - 1}"
+                                         f".*reads v{ckpt.CKPT_FORMAT}"):
         ckpt.load_state(eng.init_state(), path)
 
 

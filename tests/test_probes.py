@@ -1,0 +1,273 @@
+"""Flow-probe plane: watchlist resolution, probe-ring parity, resume.
+
+The probe contract (flow-observability acceptance): the per-window flow
+samples are bit-identical cpu-oracle ↔ tpu ↔ sharded(8) ↔ fleet-lane, a
+resumed run reproduces the straight run's rows exactly, and probes-off
+leaves the state pytree (and thus the traced program) untouched.
+"""
+
+import numpy as np
+import pytest
+
+from shadow1_tpu.config.compiled import single_vertex_experiment
+from shadow1_tpu.consts import MS, SEC, EngineParams
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+from shadow1_tpu.telemetry.probes import drain_probes
+from shadow1_tpu.telemetry.registry import PROBE_FIELDS
+from tests.test_net_parity import filexfer_exp
+
+N_WINDOWS = 25
+PROBES = ((1, 0), (0, -1))  # the client's flow + the server's host view
+PARAMS = EngineParams(metrics_ring=32, probes=PROBES)
+
+
+def _key(r):
+    return (r.get("exp", -1), r.get("window", -1), r.get("host", -1),
+            r.get("sock", -1))
+
+
+def tpu_rows(exp, params=PARAMS, n_windows=N_WINDOWS, st=None, start=0):
+    eng = Engine(exp, params)
+    st = eng.run(st, n_windows=n_windows)
+    return st, sorted(drain_probes(st, eng.window, params.probes,
+                                   start=start), key=_key)
+
+
+def cpu_rows(exp, params=PARAMS, n_windows=N_WINDOWS):
+    eng = CpuEngine(exp, params)
+    eng.run(n_windows=n_windows)
+    return sorted(eng.probe_rows, key=_key)
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+def test_probe_rows_bit_identical_cpu_vs_tpu():
+    exp = filexfer_exp()
+    _, trows = tpu_rows(exp)
+    crows = cpu_rows(exp)
+    assert len(trows) == N_WINDOWS * len(PROBES)
+    assert trows == crows
+    # The rows carry the whole declared schema, as plain ints.
+    for r in trows:
+        assert all(f in r and isinstance(r[f], int) for f in PROBE_FIELDS)
+    # The watched flow actually moved (a parity of all-zeros proves nothing).
+    assert any(r["cwnd"] > 0 for r in trows if r["sock"] == 0)
+    assert any(r["inflight"] > 0 for r in trows if r["sock"] == 0)
+
+
+def test_probe_rows_bit_identical_sharded():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from shadow1_tpu.shard.engine import ShardedEngine
+
+    # 8 hosts across 8 shards: every probe is owned by a non-zero shard at
+    # least once, so the one-hot psum gather is actually exercised.
+    exp = filexfer_exp(n_hosts=8, flow=60_000, end=10 * SEC)
+    params = EngineParams(metrics_ring=32, ev_cap=512,
+                          probes=((0, -1), (3, 0), (7, 0)))
+    _, solo = tpu_rows(exp, params)
+    sh = ShardedEngine(exp, params)
+    st = sh.run(sh.init_state(), n_windows=N_WINDOWS)
+    shrows = sorted(drain_probes(st, sh.window, params.probes), key=_key)
+    assert shrows == solo
+
+
+def test_probe_rows_fleet_lane_vs_solo():
+    from shadow1_tpu.fleet.engine import FleetEngine
+
+    exp_a = filexfer_exp(seed=11)
+    exp_b = filexfer_exp(seed=12)
+    fleet = FleetEngine([exp_a, exp_b], PARAMS)
+    st = fleet.run(n_windows=N_WINDOWS)
+    recs = fleet.drain_rings(st)
+    flows = [r for r in recs if r["type"] == "flow"]
+    assert {r["exp"] for r in flows} == {0, 1}
+    for gid, exp in ((0, exp_a), (1, exp_b)):
+        lane = sorted(
+            ({k: v for k, v in r.items() if k != "exp"}
+             for r in flows if r["exp"] == gid), key=_key)
+        _, solo = tpu_rows(exp)
+        assert lane == solo, f"lane {gid} diverged from its solo run"
+
+
+def test_probe_resume_reproduces_straight_run(tmp_path):
+    from shadow1_tpu.ckpt import load_state, save_state
+
+    exp = filexfer_exp()
+    _, straight = tpu_rows(exp)
+    eng = Engine(exp, PARAMS)
+    st = eng.run(n_windows=12)
+    first = drain_probes(st, eng.window, PROBES)
+    path = str(tmp_path / "probe.ckpt")
+    save_state(st, path)
+    eng2 = Engine(exp, PARAMS)
+    st2 = load_state(eng2.init_state(), path)
+    st2 = eng2.run(st2, n_windows=N_WINDOWS - 12)
+    rest = drain_probes(st2, eng2.window, PROBES, start=12)
+    assert sorted(first + rest, key=_key) == straight
+
+
+def test_probe_gap_record_when_chunk_exceeds_ring():
+    # Ring depth 8 but 25 windows drained in one go: the overwritten
+    # windows surface as one flow_gap record, like ring_gap.
+    exp = filexfer_exp()
+    params = EngineParams(metrics_ring=8, probes=PROBES)
+    _, rows = tpu_rows(exp, params)
+    eng = Engine(exp, params)
+    st = eng.run(n_windows=N_WINDOWS)
+    recs = drain_probes(st, eng.window, PROBES)
+    gaps = [r for r in recs if r["type"] == "flow_gap"]
+    assert len(gaps) == 1
+    assert gaps[0]["windows_lost"] == N_WINDOWS - 8
+    flows = [r for r in recs if r["type"] == "flow"]
+    assert sorted({r["window"] for r in flows}) == list(
+        range(N_WINDOWS - 8, N_WINDOWS))
+
+
+def test_probe_phold_host_view():
+    # Model dispatch: phold has no tcp/nic planes — TCP/NIC columns stay 0,
+    # pending_events is live, and the oracle mirrors it bit-exactly.
+    exp = single_vertex_experiment(
+        n_hosts=16, seed=7, end_time=60 * MS, latency_ns=1 * MS,
+        model="phold", model_cfg={"mean_delay_ns": float(2 * MS),
+                                  "init_events": 2})
+    params = EngineParams(metrics_ring=32, probes=((3, -1), (15, -1)))
+    _, trows = tpu_rows(exp, params, n_windows=20)
+    crows = cpu_rows(exp, params, n_windows=20)
+    assert trows == crows
+    assert any(r["pending_events"] > 0 for r in trows)
+    assert all(r["cwnd"] == 0 and r["nic_tx_bytes"] == 0 for r in trows)
+
+
+# ---------------------------------------------------------------------------
+# off-state and guards
+# ---------------------------------------------------------------------------
+
+def test_probes_off_leaves_state_layout_unchanged():
+    import jax
+
+    exp = filexfer_exp()
+    off = Engine(exp, EngineParams(metrics_ring=32))
+    assert off.init_state().probes is None
+    # Same treedef as a pre-probe state: checkpoints, sharding specs and
+    # the traced program are untouched unless probes are actually on
+    # (the --state-digest zero-cost rule; opcensus guards the op counts).
+    on = Engine(exp, PARAMS)
+    t_off = jax.tree_util.tree_structure(off.init_state())
+    t_on = jax.tree_util.tree_structure(on.init_state())
+    assert t_off != t_on
+    n_off = len(jax.tree_util.tree_leaves(off.init_state()))
+    n_on = len(jax.tree_util.tree_leaves(on.init_state()))
+    assert n_on == n_off + 1  # exactly the [W, K, F] buffer
+
+
+def test_probes_require_ring_on_batched_engines():
+    exp = filexfer_exp()
+    with pytest.raises(ValueError, match="metrics_ring"):
+        Engine(exp, EngineParams(probes=PROBES, metrics_ring=0))
+    # The oracle has no ring: probes work ringless there.
+    eng = CpuEngine(exp, EngineParams(probes=PROBES, metrics_ring=0))
+    eng.run(n_windows=5)
+    assert len(eng.probe_rows) == 5 * len(PROBES)
+
+
+def test_probe_ring_shape_and_dtype():
+    exp = filexfer_exp()
+    st = Engine(exp, PARAMS).init_state()
+    assert st.probes.buf.shape == (32, len(PROBES), len(PROBE_FIELDS))
+    assert st.probes.buf.dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# watchlist resolution (config path)
+# ---------------------------------------------------------------------------
+
+def _dns(counts):
+    from types import SimpleNamespace
+
+    from shadow1_tpu.config.dns import Dns
+
+    groups, start = [], 0
+    for name, n in counts:
+        groups.append(SimpleNamespace(name=name, count=n, start=start))
+        start += n
+    return Dns.from_groups(groups, np.zeros(start, np.int32))
+
+
+def test_resolve_watchlist_forms():
+    from shadow1_tpu.config.experiment import resolve_watchlist
+
+    dns = _dns([("server", 1), ("client", 4)])
+    got = resolve_watchlist(
+        ["server", "client-2:1", "client[0]:0", 3, {"host": "client[1]"},
+         {"host": 0, "sock": 2}],
+        dns, sockets_per_host=4)
+    assert got == ((0, -1), (3, 1), (1, 0), (3, -1), (2, -1), (0, 2))
+    # Duplicates collapse, first occurrence wins the order.
+    assert resolve_watchlist(["server", "server", 0], dns, 4) == ((0, -1),)
+    # A scalar entry is accepted as a one-element list.
+    assert resolve_watchlist("client-0:1", dns, 4) == ((1, 1),)
+
+
+def test_resolve_watchlist_rejects_typos_with_suggestion():
+    from shadow1_tpu.config.experiment import (
+        WatchlistError,
+        resolve_watchlist,
+    )
+
+    dns = _dns([("server", 1), ("client", 4)])
+    with pytest.raises(WatchlistError, match="did you mean 'client'"):
+        resolve_watchlist(["clinet:0"], dns, 4)
+    with pytest.raises(WatchlistError, match="out of range"):
+        resolve_watchlist(["client-0:99"], dns, 4)
+    with pytest.raises(WatchlistError, match="out of range"):
+        resolve_watchlist([99], dns, 4)
+    with pytest.raises(WatchlistError, match="socket"):
+        resolve_watchlist(["client:x"], dns, 4)
+    with pytest.raises(WatchlistError):
+        resolve_watchlist([{"hots": "client"}], dns, 4)
+
+
+def test_probes_config_section_and_engine_key_rejected(tmp_path):
+    import textwrap
+
+    from shadow1_tpu.config.experiment import load_experiment
+
+    base = textwrap.dedent("""\
+        general: {seed: 1, stop_time: 100 ms}
+        engine: {scheduler: tpu}
+        network: {single_vertex: {latency: 1 ms}}
+        hosts: [{name: h, count: 4}]
+        app: {model: phold, params: {mean_delay_ns: 2.0e7}}
+    """)
+    cfg = tmp_path / "p.yaml"
+    cfg.write_text(base + 'probes: ["h-1", "h[3]"]\n')
+    _, params, _ = load_experiment(str(cfg))
+    assert params.probes == ((1, -1), (3, -1))
+    # probes is a top-level section, not an engine knob.
+    cfg.write_text(base.replace("scheduler: tpu",
+                                "scheduler: tpu, probes: [0]"))
+    with pytest.raises(AssertionError, match="probes"):
+        load_experiment(str(cfg))
+
+
+def test_heartbeat_emits_flow_records():
+    import io
+    import json
+
+    from shadow1_tpu.obs import run_with_heartbeat
+
+    exp = filexfer_exp()
+    eng = Engine(exp, PARAMS)
+    buf = io.StringIO()
+    _, hb = run_with_heartbeat(eng, n_windows=20, every_windows=10,
+                               stream=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    flows = [r for r in lines if r["type"] == "flow"]
+    assert [r["window"] for r in flows if r["sock"] == 0] == list(range(20))
+    assert hb.flow_records == flows
