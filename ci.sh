@@ -37,7 +37,14 @@
 #                      the flow-probe smokes: the watched-flow probe
 #                      stream on the rung-1 config must be bit-identical
 #                      cpu-vs-tpu, and the flowreport stall detectors
-#                      must pass their synthetic self-test
+#                      must pass their synthetic self-test; plus the
+#                      link-telemetry smokes: the rung-1 per-edge link
+#                      records must be bit-identical cpu-vs-tpu with the
+#                      drop columns reconciling against the global
+#                      counters, the netreport weathermap detectors must
+#                      pass their synthetic self-test, and the opcensus
+#                      gate doubles as the proof that --link-telem off
+#                      (the default) adds zero traced ops
 #
 # Tests force the CPU platform with 8 virtual devices (tests/conftest.py),
 # so CI needs no accelerator; the TPU-hardware path is covered separately
@@ -48,7 +55,7 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 case "$tier" in
   smoke)
-    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py tests/test_serve.py tests/test_probes.py tests/test_pcap.py -q -m "not slow" -k "not tgen"
+    python -m pytest tests/test_config.py tests/test_events.py tests/test_rng.py tests/test_ckpt_obs.py tests/test_telemetry.py tests/test_tune.py tests/test_digest.py tests/test_txn.py tests/test_fleet.py tests/test_fleet_recover.py tests/test_preempt.py tests/test_perfobs.py tests/test_serve.py tests/test_probes.py tests/test_pcap.py tests/test_links.py -q -m "not slow" -k "not tgen"
     echo "== paritytrace bisect smoke (rung-1, injected corruption) =="
     # CPU platform like the pytest tiers (conftest forces it there; the
     # tool inherits the env) — the smoke must not depend on an accelerator.
@@ -482,6 +489,54 @@ assert d["selftest"] == "ok", d
 assert "rto_storm" in d["storm_flagged"], d
 assert d["clean_prefix_flagged"] == [], d
 print("flowreport selftest:", d["storm_flagged"], "flagged, clean prefix quiet")
+'
+    echo "== link-telemetry parity smoke (cpu vs tpu) + weathermap self-test =="
+    # The link plane (docs/SEMANTICS.md §"Link telemetry contract"): the
+    # rung-1 per-edge cumulative snapshots must be bit-identical between
+    # the batched engine's [V,V,F] accumulator and the eager oracle's
+    # per-edge mirror, and every drop column must reconcile EXACTLY with
+    # its global counter (path-aware attribution loses nothing). The
+    # opcensus gate above doubles as the links-off zero-op proof: its
+    # committed baseline predates the plane and the default build
+    # (--link-telem off) must still match it.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import dataclasses
+import shadow1_tpu
+from shadow1_tpu.config.experiment import load_experiment
+from shadow1_tpu.core.engine import Engine
+from shadow1_tpu.cpu_engine import CpuEngine
+from shadow1_tpu.telemetry.links import drain_links
+
+exp, params, _ = load_experiment("configs/rung1_filexfer.yaml")
+params = dataclasses.replace(params, link_telem=1)
+key = lambda r: (r["src_vertex"], r["dst_vertex"], r["window"])
+eng = Engine(exp, params)
+st = eng.run(n_windows=40)
+trows = sorted(drain_links(st, eng.window), key=key)
+tm = Engine.metrics_dict(st)
+ceng = CpuEngine(exp, params)
+ceng.run(n_windows=40)
+assert trows == sorted(ceng.link_rows, key=key), \
+    "link records diverged cpu vs tpu"
+assert trows and any(r["pkts"] > 0 for r in trows), "no traffic observed"
+for rows, m in ((trows, tm), (ceng.link_rows, ceng.metrics)):
+    assert sum(r["pkts"] for r in rows) == m["pkts_sent"]
+    assert sum(r["loss_drops"] for r in rows) == m["pkts_lost"]
+    assert sum(r["link_down_drops"] for r in rows) == m["link_down_pkts"]
+    assert sum(r["nic_backlog_drops"] for r in rows) == m["nic_tx_drops"]
+print(f"link records: {len(trows)} edge rows bit-identical cpu<->tpu, "
+      f"40 windows; pkts=={tm['pkts_sent']} and all drop columns "
+      f"reconcile with the global counters")
+EOF
+    # The weathermap detectors must flag all four synthetic pathologies
+    # (loss concentration, egress saturation, dark link, elephant skew)
+    # and must NOT flag the clean topology — netreport's own self-test.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.netreport \
+        --selftest | python -c '
+import json, sys
+d = json.loads(sys.stdin.read().strip().splitlines()[-1])
+assert d["selftest"] == "ok", d
+print("netreport selftest: all four pathology detectors fired, clean topology quiet")
 '
     echo "== corrupt-checkpoint recovery smoke (integrity digest) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -c '

@@ -66,7 +66,15 @@ import jax
 #      probes-on/probes-off mismatch fail as a version error. Probe rows
 #      are pure window-boundary samples, so a resumed run's flow stream
 #      continues bit-identically (same rule as the digest/work columns).
-CKPT_FORMAT = 11
+#  12: link-telemetry plane — SimState gains the optional ``links``
+#      accumulator leaf ([V,V,F] i64, telemetry/links.py; fleet:
+#      [E,V,V,F]), present only when EngineParams.link_telem is on. The
+#      accumulator holds cumulative per-edge counters and drains as pure
+#      running-total snapshots, so a resumed run's link stream continues
+#      bit-identically with no baseline bookkeeping. A telemetry-off
+#      state keeps the v11 leaf layout; the bump makes an on/off mismatch
+#      fail as a version error.
+CKPT_FORMAT = 12
 
 
 class CorruptCheckpointError(ValueError):

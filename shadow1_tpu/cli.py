@@ -565,10 +565,11 @@ def _fleet_main(args, params, plan, log, t0, capacity_exit,
         st, hb = run_fleet(
             eng, st, n_windows=args.windows,
             every_windows=args.heartbeat or (ring_w or None),
-            stream=None if (args.heartbeat or ring_w) else False,
+            stream=None if (args.heartbeat or ring_w
+                            or params.link_telem) else False,
             ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
             emit_heartbeat=bool(args.heartbeat),
-            emit_ring=bool(ring_w),
+            emit_ring=bool(ring_w or params.link_telem),
             selfcheck=bool(params.selfcheck),
             labels=labels,
             ckpt_keep=args.ckpt_keep,
@@ -736,10 +737,11 @@ def _fleet_subbatched(args, params, plan, log, t0, capacity_exit,
             st, hb = run_fleet(
                 eng, st, n_windows=remaining,
                 every_windows=args.heartbeat or (ring_w or None),
-                stream=None if (args.heartbeat or ring_w) else False,
+                stream=None if (args.heartbeat or ring_w
+                                or params.link_telem) else False,
                 ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
                 emit_heartbeat=bool(args.heartbeat),
-                emit_ring=bool(ring_w),
+                emit_ring=bool(ring_w or params.link_telem),
                 selfcheck=bool(params.selfcheck),
                 labels=labels,
                 ckpt_keep=args.ckpt_keep,
@@ -925,6 +927,16 @@ def main(argv=None) -> int:
                          "or as per-window 'digest' JSONL records on stderr "
                          "(cpu oracle). off (default) traces zero digest "
                          "ops. Compare streams with tools/paritytrace.py")
+    ap.add_argument("--link-telem", choices=["on", "off"], default=None,
+                    metavar="on|off",
+                    help="per-link telemetry plane (telemetry/links.py): "
+                         "accumulate per-edge packet/byte/drop/queued "
+                         "counters in a device-resident [V,V] tensor inside "
+                         "the window loop, drained at chunk boundaries as "
+                         "cumulative 'link' JSONL records on stderr (the cpu "
+                         "oracle mirrors them bit-exactly). off (default) "
+                         "traces zero link ops. Render with "
+                         "tools/netreport.py")
     ap.add_argument("--on-overflow", choices=["drop", "retry", "halt"],
                     default=None, metavar="drop|retry|halt",
                     help="overflow policy at chunk boundaries "
@@ -1037,6 +1049,11 @@ def main(argv=None) -> int:
 
         params = dataclasses.replace(
             params, state_digest=int(args.state_digest == "on"))
+    if args.link_telem is not None:
+        import dataclasses
+
+        params = dataclasses.replace(
+            params, link_telem=int(args.link_telem == "on"))
     if (params.state_digest and params.metrics_ring <= 0
             and args.metrics_ring is None and engine_kind != "cpu"):
         # The digest words are ring columns on the batched engines; give the
@@ -1383,6 +1400,11 @@ def main(argv=None) -> int:
             # samples the boundary state straight into rows).
             for rec in eng.probe_rows:
                 print(json.dumps(rec), file=sys.stderr)
+        if eng.link_rows:
+            # The oracle's cumulative per-edge link stream (REC_LINK rows)
+            # — the comparand for the batched engines' link records.
+            for rec in eng.link_rows:
+                print(json.dumps(rec), file=sys.stderr)
     else:
         import jax
 
@@ -1507,6 +1529,7 @@ def main(argv=None) -> int:
                 # overflow policy and --selfcheck (both are chunk-boundary
                 # checks).
                 if (args.heartbeat or args.ckpt or ring_w
+                        or params.link_telem
                         or phases is not None or controller is not None
                         or guard is not None or params.selfcheck):
                     from shadow1_tpu.obs import run_with_heartbeat
@@ -1525,11 +1548,12 @@ def main(argv=None) -> int:
                         # --ckpt/--trace without --heartbeat chunk the run
                         # but emit no heartbeat lines; ring records always
                         # flow when the ring is on.
-                        stream=None if (args.heartbeat or ring_w) else False,
+                        stream=None if (args.heartbeat or ring_w
+                                        or params.link_telem) else False,
                         ckpt_path=args.ckpt, ckpt_every_s=args.ckpt_every_s,
                         profiler=phases,
                         emit_heartbeat=bool(args.heartbeat),
-                        emit_ring=bool(ring_w),
+                        emit_ring=bool(ring_w or params.link_telem),
                         controller=controller,
                         guard=guard,
                         selfcheck=bool(params.selfcheck),

@@ -62,6 +62,10 @@ class CompiledExperiment:
     # Host-side name registry (config/dns.py); None for programmatic
     # experiments (ids only). Never enters device state.
     dns: Any = None
+    # Topology vertex names in id order (GraphML node ids, or ["v0"] for
+    # single_vertex); None for programmatic experiments. Host-side only —
+    # link records and the pcapdump --edge filter resolve through it.
+    vertex_names: Any = None
 
     def __post_init__(self):
         h, z = self.n_hosts, np.int64

@@ -236,6 +236,15 @@ class EngineParams:
     # = off: zero digest ops traced anywhere — the ring columns exist but
     # hold zeros. CLI --state-digest.
     state_digest: int = 0
+    # Link-telemetry plane (telemetry/links.py): 1 = carry the [V, V, F]
+    # per-edge accumulator in SimState and scatter-add every routed
+    # packet's edge contribution at the window-end route phase (plus NIC
+    # drop-tail drops at the tx sites), drained at chunk boundaries into
+    # JSONL ``link`` records. 0 (default) = off: no link leaf rides
+    # SimState and zero link ops are traced — the --state-digest rule.
+    # The accumulator is never digested, so 1 is digest-neutral. CLI
+    # --link-telem.
+    link_telem: int = 0
     # Overflow policy (shadow1_tpu/txn.py; CLI --on-overflow): what the
     # chunk runner does when a chunk's fresh overflow deltas (ev_overflow /
     # ob_overflow / sharded x2x_overflow) are non-zero at its boundary.
@@ -304,6 +313,7 @@ class EngineParams:
         assert self.pop_extract in ("sum", "gather"), self.pop_extract
         assert self.metrics_ring >= 0, self.metrics_ring
         assert self.state_digest in (0, 1), self.state_digest
+        assert self.link_telem in (0, 1), self.link_telem
         assert isinstance(self.probes, tuple), (
             "probes must be a tuple of (host, sock) int pairs "
             "(resolve_watchlist builds it)")

@@ -147,6 +147,10 @@ class SimState(NamedTuple):
     # EngineParams.probes is empty — same None-leaf rule as the telemetry
     # ring, so a probe-less state keeps the historic layout.
     probes: Any = None
+    # Link-telemetry accumulator (telemetry/links.LinkAccum, [V, V, F]) or
+    # None when EngineParams.link_telem == 0 — same None-leaf rule again;
+    # never digested, so carrying it is digest-neutral by construction.
+    links: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -350,8 +354,7 @@ def run_round(st: SimState, ctx: Ctx, handlers: dict, win_end) -> SimState:
     return st
 
 
-def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray,
-                                                jnp.ndarray, jnp.ndarray]:
+def route_outbox(ctx: Ctx, ob: Outbox, links=None, win_start=None):
     """Route this block's outbox: latency gather + fault gates + loss draws.
 
     The tensor analogue of the reference's topology path lookup at send time
@@ -361,7 +364,14 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray,
     window is dropped deterministically (counted ``link_down_pkts``, never
     in ``pkts_lost``); otherwise the Bernoulli loss draw applies at the
     path's threshold — replaced by an active timed loss ramp's, same coin
-    bits either way. Returns (flat_packets, n_sent, n_lost, n_linkdown)."""
+    bits either way. Returns (flat_packets, n_sent, n_lost, n_linkdown).
+
+    With the link plane on (``links`` a LinkAccum, ``win_start`` the window
+    start), every offered packet's edge contribution — counts, wire bytes,
+    drop partition, NIC queueing ns (depart − win_start) — is scatter-added
+    here, at the routing attribution point, and the updated accumulator is
+    returned as a fifth element (docs/SEMANTICS.md §"Link telemetry
+    contract")."""
     cap, h = ob.dst.shape
     mask = jnp.arange(cap)[:, None] < ob.cnt[None, :]
     src = jnp.broadcast_to(ctx.hosts[None, :], (cap, h))
@@ -404,8 +414,19 @@ def route_outbox(ctx: Ctx, ob: Outbox) -> tuple[FlatPackets, jnp.ndarray,
         dst=fdst_safe, arrival=arrival, tb=tb, kind=flat(ob.kind), p=flat(ob.p),
         keep=keep,
     )
-    return (fp, fmask.sum(dtype=jnp.int64), lost.sum(dtype=jnp.int64),
-            linkdown.sum(dtype=jnp.int64))
+    out = (fp, fmask.sum(dtype=jnp.int64), lost.sum(dtype=jnp.int64),
+           linkdown.sum(dtype=jnp.int64))
+    if links is None:
+        return out
+    from shadow1_tpu.consts import WIRE_OVERHEAD
+    from shadow1_tpu.telemetry.links import link_route_accum
+
+    links = link_route_accum(
+        links, vs, vd, fmask, lost, linkdown,
+        queued=fdep - win_start,
+        wire=flat(ob.p)[4].astype(jnp.int64) + WIRE_OVERHEAD,
+    )
+    return out + (links,)
 
 
 def deliver_flat(evbuf, ctx: Ctx, fp: FlatPackets):
@@ -445,7 +466,12 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     from shadow1_tpu.core.outbox import outbox_fill
 
     with jax.named_scope("phase:route"):
-        fp, n_sent, n_lost, n_linkdown = route_outbox(ctx, st.outbox)
+        links = st.links
+        if links is not None:
+            fp, n_sent, n_lost, n_linkdown, links = route_outbox(
+                ctx, st.outbox, links=links, win_start=st.win_start)
+        else:
+            fp, n_sent, n_lost, n_linkdown = route_outbox(ctx, st.outbox)
     # Maintained [H] counters — read before the window-end clear. ob_hosts
     # is the wasted-work gauge's numerator: hosts that actually used the
     # [P, H] outbox planes this window (the oracle mirrors per-window send
@@ -462,6 +488,7 @@ def deliver_window(st: SimState, ctx: Ctx, exchange=None) -> SimState:
     return st._replace(
         evbuf=evbuf,
         outbox=outbox_clear(st.outbox),
+        links=links,
         metrics=m._replace(
             pkts_sent=m.pkts_sent + n_sent,
             pkts_delivered=m.pkts_delivered + n_deliv,
@@ -510,6 +537,8 @@ class WindowFrame(NamedTuple):
     win_end: jnp.ndarray    # i64 scalar
     cap_hit: jnp.ndarray    # bool scalar (set by the rounds phase)
     dg_ob: jnp.ndarray      # i64 outbox digest word (digest runs only)
+    l_entry: Any = None     # link accumulator at window entry (the sharded
+                            # per-window psum's delta baseline; link runs only)
 
 
 def window_frame(st: SimState, ctx: Ctx) -> WindowFrame:
@@ -520,11 +549,13 @@ def window_frame(st: SimState, ctx: Ctx) -> WindowFrame:
         win_end=st.win_start + ctx.window,
         cap_hit=jnp.zeros((), bool),
         dg_ob=jnp.zeros((), jnp.int64),
+        l_entry=st.links,
     )
 
 
 def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
-                  make_handlers=None, telem_reduce=None, probe_reduce=None):
+                  make_handlers=None, telem_reduce=None, probe_reduce=None,
+                  link_reduce=None):
     """The ordered (name, frame → frame) stage list of one window.
 
     The phase decomposition of the jitted ``window_step`` (performance
@@ -682,6 +713,14 @@ def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
             if probe_reduce is not None:
                 row = probe_reduce(row)
             st = st._replace(probes=probe_record(st.probes, fr.m_entry, row))
+        if st.links is not None and link_reduce is not None:
+            # Link-accumulator globalization (sharded runs only): psum this
+            # window's per-shard counter deltas onto the entry baseline and
+            # pmax the high-water column, so every shard carries the exact
+            # single-device tensor at the boundary (shard/engine.py
+            # link_reduce; identity is link_reduce=None elsewhere — zero
+            # per-window overhead off the sharded path).
+            st = st._replace(links=link_reduce(fr.l_entry, st.links))
         return fr._replace(st=st)
 
     return [("prepare", ph_prepare), ("rounds", ph_rounds),
@@ -690,7 +729,8 @@ def window_phases(ctx: Ctx, handlers: dict, exchange=None, pre_window=None,
 
 def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
                 pre_window=None, make_handlers=None,
-                telem_reduce=None, probe_reduce=None) -> SimState:
+                telem_reduce=None, probe_reduce=None,
+                link_reduce=None) -> SimState:
     """One conservative window: inner rounds to quiescence, then delivery.
 
     The batched form of the reference's barrier round
@@ -717,7 +757,8 @@ def window_step(st: SimState, ctx: Ctx, handlers: dict, exchange=None,
     carry them as spans)."""
     fr = window_frame(st, ctx)
     for name, fn in window_phases(ctx, handlers, exchange, pre_window,
-                                  make_handlers, telem_reduce, probe_reduce):
+                                  make_handlers, telem_reduce, probe_reduce,
+                                  link_reduce):
         with jax.named_scope(f"phase:{name}"):
             fr = fn(fr)
     return fr.st
@@ -898,6 +939,9 @@ class Engine:
         self.params = params or EngineParams()
         check_digest_params(self.params)
         check_probe_params(self.params)
+        from shadow1_tpu.telemetry.links import check_link_params
+
+        check_link_params(self.params, np.asarray(exp.lat_vv).shape[0])
         self.params = _resolve_kernel_impls(self.params, exp.n_hosts)
         self.window = exp.window
         self.n_windows = int(-(-exp.end_time // self.window))
@@ -930,6 +974,7 @@ class Engine:
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.links import link_init
         from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
@@ -945,6 +990,8 @@ class Engine:
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
             probes=probe_init(self.params.metrics_ring, self.params.probes),
+            links=link_init(self.params.link_telem,
+                            np.asarray(self.exp.lat_vv).shape[0]),
         )
 
     def place_state(self, st: SimState) -> SimState:

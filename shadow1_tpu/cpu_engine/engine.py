@@ -166,6 +166,25 @@ class CpuEngine:
         # rows accumulate directly.
         self.probe_on = bool(self.params.probes)
         self.probe_rows: list[dict] = []
+        # Link-telemetry plane (telemetry/links.py): the oracle maintains
+        # the same cumulative [V, V, F] per-edge accumulator — offered
+        # packets / wire bytes / queued ns at the send gates below, drop
+        # partition at the matching gate, NIC drop-tail drops via
+        # _link_nic_drop from the model's _tx — and emits the same
+        # cumulative ``link`` snapshot records at run boundaries
+        # (link_rows). Bit-exact against the batched engines at any window
+        # boundary: every column is a sum/max over the same per-packet
+        # integers, and summation order cannot matter.
+        self.link_on = bool(self.params.link_telem)
+        self.link_rows: list[dict] = []
+        self._link_next = 0  # last drained window boundary (never re-emit)
+        if self.link_on:
+            from shadow1_tpu.telemetry.links import check_link_params
+            from shadow1_tpu.telemetry.registry import LINK_FIELDS
+
+            v = np.asarray(exp.lat_vv).shape[0]
+            check_link_params(self.params, v)
+            self._link_acc = np.zeros((v, v, len(LINK_FIELDS)), np.int64)
         self._work_pending: dict[int, dict] = {}  # window → open row
         self._ob_hosts: dict[int, int] = {}       # window → distinct senders
         self._work_next_open = 0                  # next window to sample
@@ -290,11 +309,27 @@ class CpuEngine:
         self.metrics["pkts_sent"] += 1
         vs = int(self.exp.host_vertex[src])
         vd = int(self.exp.host_vertex[dst])
+        if self.link_on:
+            # Offered on the edge (the pkts_sent population): counts, wire
+            # bytes, NIC queueing ns — mirror of link_route_accum's scatter
+            # (depart − window start of the send window; the TPU routes at
+            # the end of the window the outbox slot was consumed in).
+            from shadow1_tpu.consts import WIRE_OVERHEAD
+
+            a = self._link_acc[vs, vd]
+            a[0] += 1
+            a[1] += (int(p[4]) if len(p) > 4 else 0) + WIRE_OVERHEAD
+            q = depart - (now // self.window) * self.window
+            a[5] += q
+            if q > a[6]:
+                a[6] = q
         if self.has_link_fault and self._link_down(vs, vd, depart):
             # Link outage (fault plane): deterministic drop on departure,
             # BEFORE the loss draw — counted separately, never in
             # pkts_lost (route_outbox orders the gates identically).
             self.metrics["link_down_pkts"] += 1
+            if self.link_on:
+                self._link_acc[vs, vd, 3] += 1
             if self.capture is not None:
                 self.capture(depart, src, dst, p, True)
             return True
@@ -303,6 +338,8 @@ class CpuEngine:
             thr = self._ramp_thr(vs, vd, depart, thr)
         if int(self.draws.bits(R_LOSS, src, ctr)) < thr:
             self.metrics["pkts_lost"] += 1
+            if self.link_on:
+                self._link_acc[vs, vd, 2] += 1
             if self.capture is not None:
                 self.capture(depart, src, dst, p, True)
             return True
@@ -522,6 +559,40 @@ class CpuEngine:
             rec.update({f: int(cols[f]) for f in PROBE_FIELDS})
             self.probe_rows.append(rec)
 
+    def _link_nic_drop(self, src: int, dst: int) -> None:
+        """Egress-edge attribution of a NIC uplink drop-tail drop — the
+        oracle twin of telemetry.links.link_nic_drops (called from the
+        model's _tx at the exact nic_tx_drops site; RED drops excluded)."""
+        if self.link_on:
+            vs = int(self.exp.host_vertex[src])
+            vd = int(self.exp.host_vertex[dst])
+            self._link_acc[vs, vd, 4] += 1
+
+    def _drain_links(self, done: int) -> None:
+        """Cumulative per-edge ``link`` snapshots at window boundary
+        ``done`` — field-for-field the records telemetry.links.drain_links
+        emits from the batched accumulator at the same boundary. The
+        cursor keeps run() continuations (paritytrace lockstep chunks)
+        from re-emitting a boundary."""
+        if not self.link_on or done <= self._link_next:
+            return
+        from shadow1_tpu.consts import SEC
+        from shadow1_tpu.telemetry.registry import LINK_FIELDS, REC_LINK
+
+        self._link_next = done
+        t = round(done * self.window / SEC, 9)
+        for vs, vd in zip(*np.nonzero(self._link_acc.any(axis=-1))):
+            rec = {
+                "type": REC_LINK,
+                "window": done - 1,
+                "sim_time_s": t,
+                "src_vertex": int(vs),
+                "dst_vertex": int(vd),
+            }
+            rec.update({f: int(x) for f, x in
+                        zip(LINK_FIELDS, self._link_acc[vs, vd])})
+            self.link_rows.append(rec)
+
     def _digest_planes(self) -> tuple[int, int, int]:
         """(dg_tcp, dg_nic, dg_rng) of the CURRENT state — the oracle twins
         of core/digest.py's plane digests, same element words, same field
@@ -591,6 +662,7 @@ class CpuEngine:
             self.model.handle(host, time, kind, p)
         # Remaining boundaries up to the run end see a static pending set.
         self._sample_fill(end)
+        self._drain_links(end // self.window)
         return dict(self.metrics)
 
     def summary(self) -> dict[str, Any]:

@@ -226,9 +226,11 @@ class CpuNetModel:
         self.rx_bytes[host] += wire
         self.eng.schedule_packet(host, ready, tb, K_PKT_DELIVER, p)
 
-    def _tx(self, host: int, wire: int, now: int) -> int | None:
+    def _tx(self, host: int, wire: int, now: int, dst: int) -> int | None:
         """Reserve the uplink; None = dropped (RED early-drop, then
-        drop-tail on the queue bound — the order tx_stamp uses)."""
+        drop-tail on the queue bound — the order tx_stamp uses). ``dst``
+        is the destination host, for the link plane's egress-edge
+        attribution of drop-tail drops."""
         if self.has_aqm:
             ctr = int(self.aqm_ctr[host])
             self.aqm_ctr[host] += 1
@@ -246,6 +248,7 @@ class CpuNetModel:
                     return None
         if self.has_tx_qlen and (int(self.tx_free[host]) - now) > int(self.tx_qlen_ns[host]):
             self.eng.metrics["nic_tx_drops"] += 1
+            self.eng._link_nic_drop(host, dst)
             return None
         depart = max(now, int(self.tx_free[host]))
         self.tx_free[host] = depart + ser_delay_ns(wire, int(self.eng.exp.bw_up[host]))
@@ -266,14 +269,14 @@ class CpuNetModel:
             0,
             0,
         )
-        depart = self._tx(h, length + WIRE_OVERHEAD, now)
+        depart = self._tx(h, length + WIRE_OVERHEAD, now, k.peer_host)
         if depart is None:  # queue-dropped: behaves like loss, rtx recovers
             return
         self.eng.send(h, k.peer_host, K_PKT, depart, p, now=now)
 
     def udp_send(self, h, dst_host, dst_sock, length, meta, meta2, now):
         p = (h, (dst_sock << 8) | (F_DGRAM << 16), 0, 0, length, 0, 0, meta, meta2, 0)
-        depart = self._tx(h, length + WIRE_OVERHEAD, now)
+        depart = self._tx(h, length + WIRE_OVERHEAD, now, dst_host)
         if depart is None:
             return
         self.eng.send(h, dst_host, K_PKT, depart, p, now=now)

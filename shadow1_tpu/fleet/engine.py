@@ -154,6 +154,35 @@ def drain_fleet_probes(st: SimState, window_ns: int, probes: tuple,
     return recs
 
 
+def drain_fleet_links(st: SimState, window_ns: int, start: int = 0,
+                      exp_base: int = 0, exp_ids=None) -> list[dict]:
+    """Per-experiment link drain: the solo ``drain_links`` per lane over
+    the [E, V, V, F] fleet accumulator, each ``link`` record tagged with
+    its sweep-global experiment id (``exp``) — same id rules and same
+    two-fetch-then-numpy-views discipline as ``drain_fleet_rings``. A
+    fresh lane bound mid-sweep (recovery-plane rebind) whose window count
+    sits below the sweep cursor yields that lane's ``link_gap`` rebase
+    marker, exactly as the solo drain would."""
+    from types import SimpleNamespace
+
+    from shadow1_tpu.telemetry.links import drain_links
+
+    if getattr(st, "links", None) is None:
+        return []
+    buf = np.asarray(st.links.buf)               # [E, V, V, F]
+    windows = np.asarray(st.metrics.windows)     # [E]
+    recs: list[dict] = []
+    for e in range(buf.shape[0]):
+        lane = SimpleNamespace(
+            links=SimpleNamespace(buf=buf[e]),
+            metrics=SimpleNamespace(windows=int(windows[e])),
+        )
+        gid = exp_ids[e] if exp_ids is not None else e + exp_base
+        for r in drain_links(lane, window_ns, start=start):
+            recs.append({**r, "exp": int(gid)})
+    return recs
+
+
 def _stack_host_intervals(exps) -> tuple[np.ndarray, np.ndarray]:
     """Per-experiment [K_i, H] down/up interval tensors → [E, Kmax, H],
     padded with the empty [NO_STOP, NO_STOP) interval no time satisfies."""
@@ -242,6 +271,9 @@ class FleetEngine:
         check_uniform(exps, [self.params] * len(exps))
         check_digest_params(self.params)
         check_probe_params(self.params)
+        from shadow1_tpu.telemetry.links import check_link_params
+
+        check_link_params(self.params, np.asarray(exps[0].lat_vv).shape[0])
         self.params = self._resolve_fleet_params(self.params)
         self.exps = list(exps)
         self.exp = exps[0]
@@ -372,6 +404,7 @@ class FleetEngine:
 
     # -- state -------------------------------------------------------------
     def _lane_init_state(self, var: dict) -> SimState:
+        from shadow1_tpu.telemetry.links import link_init
         from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
@@ -389,6 +422,8 @@ class FleetEngine:
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
             probes=probe_init(self.params.metrics_ring, self.params.probes),
+            links=link_init(self.params.link_telem,
+                            np.asarray(self.exp.lat_vv).shape[0]),
         )
 
     def init_state(self) -> SimState:
@@ -554,6 +589,9 @@ class FleetEngine:
         recs += drain_fleet_probes(st, self.window, self.params.probes,
                                    start=start, exp_base=self.exp_base,
                                    exp_ids=self.exp_ids)
+        recs += drain_fleet_links(st, self.window, start=start,
+                                  exp_base=self.exp_base,
+                                  exp_ids=self.exp_ids)
         return recs
 
     @staticmethod

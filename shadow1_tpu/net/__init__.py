@@ -96,7 +96,7 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
     k = jnp.full(ctx.n_hosts, K_PKT, jnp.int32)
     outbox, ok = outbox_append(st.outbox, sent, dst_host, k, depart, p)
     m = st.metrics
-    return st._replace(
+    st = st._replace(
         model=st.model._replace(nic=nic),
         outbox=outbox,
         metrics=m._replace(
@@ -106,6 +106,14 @@ def udp_send(st, ctx, mask, dst_host, dst_sock, length, meta, meta2, now):
             nic_aqm_drops=m.nic_aqm_drops + red.sum(dtype=jnp.int64),
         ),
     )
+    if st.links is not None:
+        # Link plane: drop-tail losses never reach route_outbox, so their
+        # egress-edge attribution happens here, at the tx site.
+        from shadow1_tpu.telemetry.links import link_nic_drops
+
+        st = st._replace(links=link_nic_drops(
+            st.links, ctx, mask & ~sent & ~red, dst_host))
+    return st
 
 
 def make_pre_window(ctx):

@@ -71,9 +71,14 @@ class Heartbeat:
         self._ring_next: int = self.last.get("windows", 0)
         # Same cursor for the flow-probe ring (telemetry/probes.py).
         self._probe_next: int = self.last.get("windows", 0)
+        # And for the link accumulator (telemetry/links.py) — link records
+        # are cumulative snapshots, so the cursor only suppresses re-drains
+        # of already-emitted boundaries on resume.
+        self._link_next: int = self.last.get("windows", 0)
         self.records: list[dict] = []
         self.ring_records: list[dict] = []
         self.flow_records: list[dict] = []
+        self.link_records: list[dict] = []
 
     def _emit(self, rec: dict) -> None:
         if self.stream:
@@ -86,6 +91,7 @@ class Heartbeat:
             m = normalize(_metrics_mapping(st.metrics))
             ring_recs = self._drain_ring(st)
             flow_recs = self._drain_probes(st)
+            link_recs = self._drain_links(st)
         delta = {k: v - self.last.get(k, 0) for k, v in m.items()}
         dt = now - self.t_last
         sim_ns = int(st.win_start)  # the true sim clock (resume-aware)
@@ -189,6 +195,10 @@ class Heartbeat:
             self.flow_records.append(r)
             if self.emit_ring:
                 self._emit(r)
+        for r in link_recs:
+            self.link_records.append(r)
+            if self.emit_ring:
+                self._emit(r)
         self.t_last = now
         self.last = m
 
@@ -213,6 +223,18 @@ class Heartbeat:
         recs = drain_probes(st, self.engine.window, probes,
                             start=self._probe_next)
         self._probe_next = int(st.metrics.windows)
+        return recs
+
+    def _drain_links(self, st) -> list[dict]:
+        """Cumulative per-edge link snapshot at this chunk boundary (solo
+        engines; the fleet engine's drain_rings handles its [E,...]
+        accumulator)."""
+        if getattr(st, "links", None) is None:
+            return []
+        from shadow1_tpu.telemetry.links import drain_links
+
+        recs = drain_links(st, self.engine.window, start=self._link_next)
+        self._link_next = int(st.metrics.windows)
         return recs
 
 

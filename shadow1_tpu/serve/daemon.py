@@ -196,7 +196,10 @@ class _RecordRouter:
         except ValueError:
             return
         t = rec.get("type")
-        if t in ("ring", "ring_gap", "work", "digest"):
+        if t in ("ring", "ring_gap", "work", "digest",
+                 "link", "link_gap", "flow", "flow_gap"):
+            if t == "link":
+                self.daemon._note_link(rec)
             job = self.lane_jobs.get(rec.get("exp"))
             if job is not None:
                 self._append(job, {**rec, "job": job})
@@ -240,7 +243,8 @@ class ServeDaemon:
         self.jobs: dict[str, ServeJob] = {}   # every live ServeJob by id
         self.ledger = {k: 0 for k in
                        ("jobs_submitted", "jobs_rejected", "jobs_done",
-                        "jobs_failed", "jobs_evicted", "batches_run")}
+                        "jobs_failed", "jobs_evicted", "batches_run",
+                        "top_edge_bytes", "top_edge_drops")}
         self.running: list[str] = []          # job ids of in-flight batch
         self._resident_bytes = 0              # in-flight batch estimate
         self._drain = None                    # preempt.DrainHandler
@@ -273,6 +277,19 @@ class ServeDaemon:
     def _event(self, event: str, **fields) -> None:
         self._log({"type": "serve", "event": event, "t": time.time(),
                    **fields})
+
+    def _note_link(self, rec: dict) -> None:
+        """Track the hottest / lossiest edge seen across every batch (link
+        records are cumulative snapshots, so per-edge maxima are just the
+        latest values) — exported as the top_edge_* Prometheus gauges."""
+        b = int(rec.get("bytes", 0))
+        d = (int(rec.get("loss_drops", 0))
+             + int(rec.get("link_down_drops", 0))
+             + int(rec.get("nic_backlog_drops", 0)))
+        if b > self.ledger.get("top_edge_bytes", 0):
+            self.ledger["top_edge_bytes"] = b
+        if d > self.ledger.get("top_edge_drops", 0):
+            self.ledger["top_edge_drops"] = d
 
     def ledger_dict(self) -> dict[str, int]:
         return {**self.ledger, "jobs_queued": len(self.queue),

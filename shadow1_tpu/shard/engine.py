@@ -100,6 +100,9 @@ class ShardedEngine:
 
         check_digest_params(self.params)
         check_probe_params(self.params)
+        from shadow1_tpu.telemetry.links import check_link_params
+
+        check_link_params(self.params, np.asarray(exp.lat_vv).shape[0])
         devices = list(devices if devices is not None else jax.devices())
         self.n_dev = len(devices)
         if exp.n_hosts % self.n_dev:
@@ -171,7 +174,8 @@ class ShardedEngine:
         # Spec'd explicitly so a ring whose trailing dim happens to equal
         # n_hosts can never be mis-sharded by the shape heuristic.
         specs = jax.tree.map(self._spec_for, st._replace(telem=None,
-                                                         probes=None))
+                                                         probes=None,
+                                                         links=None))
         if st.telem is not None:
             specs = specs._replace(telem=jax.tree.map(lambda _: P(), st.telem))
         # The probe ring is [W, K, F] — replicated for the same reason (the
@@ -181,10 +185,16 @@ class ShardedEngine:
         if st.probes is not None:
             specs = specs._replace(
                 probes=jax.tree.map(lambda _: P(), st.probes))
+        # The link accumulator is [V, V, F] vertex-keyed — no host axis, so
+        # it is replicated; link_reduce globalizes each window's deltas.
+        if st.links is not None:
+            specs = specs._replace(
+                links=jax.tree.map(lambda _: P(), st.links))
         return specs
 
     # -- state -------------------------------------------------------------
     def init_state(self) -> SimState:
+        from shadow1_tpu.telemetry.links import link_init
         from shadow1_tpu.telemetry.probes import probe_init
         from shadow1_tpu.telemetry.ring import ring_init
 
@@ -200,6 +210,8 @@ class ShardedEngine:
             cpu_busy=jnp.zeros(self.exp.n_hosts, jnp.int64),
             telem=ring_init(self.params.metrics_ring),
             probes=probe_init(self.params.metrics_ring, self.params.probes),
+            links=link_init(self.params.link_telem,
+                            np.asarray(self.exp.lat_vv).shape[0]),
         )
         return self.place_state(st)
 
@@ -398,13 +410,33 @@ class ShardedEngine:
                 # pmax_, sum-only collectives).
                 return jax.lax.psum(row, axis)
 
+            def link_reduce(entry, cur):
+                # Globalize the [V, V, F] link accumulator at a window
+                # boundary. route_outbox runs per-shard PRE-exchange, so
+                # every offered packet is scattered exactly once (on its
+                # source shard) and the NIC drop sites hit the source shard
+                # only — the per-window counter deltas partition across
+                # shards and their psum, added back onto the replicated
+                # entry baseline, is bit-identical to the single-device
+                # tensor. The queued_ns_max column is a high-water gauge:
+                # cross-shard max via the one-hot psum (sum-only
+                # collectives, see pmax_).
+                from shadow1_tpu.telemetry.links import LINK_MAX_COL
+                d = cur.buf - entry.buf
+                ctr = entry.buf[..., :LINK_MAX_COL] + jax.lax.psum(
+                    d[..., :LINK_MAX_COL], axis)
+                mx = pmax_(cur.buf[..., LINK_MAX_COL])
+                return cur._replace(buf=jnp.concatenate(
+                    [ctr, mx[..., None]], axis=-1))
+
             init_metrics = st.metrics
             st = jax.lax.fori_loop(
                 0, n_windows,
                 lambda _, s: window_step(s, ctx, handlers, exchange, pre_window,
                                          make_handlers=model.make_handlers,
                                          telem_reduce=telem_reduce,
-                                         probe_reduce=probe_reduce),
+                                         probe_reduce=probe_reduce,
+                                         link_reduce=link_reduce),
                 st,
             )
             # Each shard accumulated its own partials on top of the (replicated)

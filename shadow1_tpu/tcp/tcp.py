@@ -211,7 +211,7 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
     # sequence state advanced, packet never routed — retransmission recovers.
     outbox, ok = outbox_append(st.outbox, sent, r.g("peer_host"), k, depart, p)
     m = st.metrics
-    return st._replace(
+    st = st._replace(
         model=st.model._replace(nic=nic), outbox=outbox,
         metrics=m._replace(
             nic_tx_drops=m.nic_tx_drops
@@ -223,6 +223,14 @@ def _emit(st, ctx, r: Sock, mask, flags, seq, length, mend, mmeta, now):
             ob_overflow=m.ob_overflow + (sent & ~ok).sum(dtype=jnp.int64),
         ),
     )
+    if st.links is not None:
+        # Link plane: egress-edge attribution of the drop-tail losses that
+        # never reach route_outbox (same rule as net.udp_send).
+        from shadow1_tpu.telemetry.links import link_nic_drops
+
+        st = st._replace(links=link_nic_drops(
+            st.links, ctx, mask & ~sent & ~red, r.g("peer_host")))
+    return st
 
 
 from shadow1_tpu.core.engine import push_local_event as _push_local  # noqa: E402
@@ -304,6 +312,10 @@ def _tcp_flush(st, ctx, mask, sock, now):
     lanes = []  # per-lane (sent, depart, seq, length, flags, mend, mmeta)
     n_tx_drop = jnp.zeros((), jnp.int64)
     n_red = jnp.zeros((), jnp.int64)
+    # Link plane: per-HOST drop-tail counts across the burst lanes (each
+    # host flushes one sock per call, so peer_host is the egress edge for
+    # every lane). None when the plane is off — zero extra traced ops.
+    tx_drop_h = jnp.zeros(H, jnp.int64) if st.links is not None else None
     ts_seq = g("ts_seq")
     ts_time = g64("ts_time")
     ts_first = jnp.zeros(H, bool)  # any lane took the RTT sample
@@ -350,6 +362,8 @@ def _tcp_flush(st, ctx, mask, sock, now):
         )
         n_tx_drop = n_tx_drop + (can & ~sent & ~red).sum(dtype=jnp.int64)
         n_red = n_red + red.sum(dtype=jnp.int64)
+        if tx_drop_h is not None:
+            tx_drop_h = tx_drop_h + (can & ~sent & ~red)
         lanes.append((sent, depart, nxt, length, flags, mend, mmeta))
         new_nxt = nxt + length + jnp.where(seg_syn | seg_fin, 1, 0)
         # RTT sample (Karn): first sample-taking segment of the burst wins.
@@ -448,6 +462,11 @@ def _tcp_flush(st, ctx, mask, sock, now):
             ob_overflow=m.ob_overflow + n_ob_over,
         ),
     )
+    if tx_drop_h is not None:
+        from shadow1_tpu.telemetry.links import link_nic_drops
+
+        st = st._replace(links=link_nic_drops(
+            st.links, ctx, tx_drop_h, peer_host))
     st = _push_local(st, ctx, need_ev, now64 + rto, K_TCP_TIMER, p0=sock)
 
     # Still pending but couldn't send → one TX_RESUME per sock (deduped).

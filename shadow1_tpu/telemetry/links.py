@@ -1,0 +1,188 @@
+"""On-device link-telemetry accumulator — per-edge counters of the topology.
+
+The telemetry ring sees the ENGINE (counter deltas), the probe ring sees
+FLOWS (watched sockets); neither can answer "which link saturated, lost,
+or went dark" — the nine global drop reasons have no topology coordinates.
+This module gives the routing plane per-edge eyes without breaking the
+zero-mid-window-host-sync contract:
+
+* a device-resident ``[V, V, F]`` i64 accumulator (``registry.LINK_FIELDS``
+  columns, keyed (src_vertex, dst_vertex)) rides in ``SimState.links``;
+* ``route_outbox`` scatter-adds every routed packet's contribution at the
+  window-end route phase (one ``.at[].add`` + one ``.at[].max``, entirely
+  inside the jitted loop), and the NIC tx sites scatter drop-tail drops
+  onto their egress edge as they happen (``link_nic_drops``);
+* at chunk boundaries the host drains CUMULATIVE per-edge snapshots into
+  JSONL ``link`` records (``drain_links``) — one record per active edge,
+  running totals, so a drain is a pure function of device state and every
+  engine's stream at the same boundary is bit-identical. Consumers diff
+  consecutive snapshots per edge for rates (tools/netreport.py).
+
+Every column except ``queued_ns_max`` is additive: under sharding each
+shard accumulates its own hosts' packets (routing runs per-shard BEFORE
+the all_to_all exchange, and NIC drops happen on the source shard), so the
+per-window psum of the deltas reconstructs the exact single-device tensor
+(shard/engine.py link_reduce); ``queued_ns_max`` max-reduces like the fill
+gauges. Fleet lanes vmap to [E, V, V, F] with exp-tagged records. The
+plane defaults off: ``link_init`` returns None, no pytree leaf exists, and
+the traced program is bit-identical to a link-less build (the
+``--state-digest`` rule); the accumulator is never digested, so enabling
+it is digest-neutral by construction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from shadow1_tpu.consts import SEC
+from shadow1_tpu.telemetry.registry import (
+    LINK_FIELDS,
+    LINK_MAX_COL,
+    REC_LINK,
+    REC_LINK_GAP,
+)
+
+# Dense [V, V, F] memory bound: the plane targets PoP-level topologies
+# (the GraphML vertex graph), not per-host meshes. 1024 vertices is 56 MB
+# of i64 accumulator — beyond it the top-K variant this plane reserves
+# ``link_telem > 1`` for is the right tool, and we refuse loudly instead
+# of silently OOM-ing the device.
+MAX_DENSE_VERTICES = 1024
+
+
+class LinkAccum(NamedTuple):
+    """The device-resident accumulator: running totals per directed edge."""
+
+    buf: "jnp.ndarray"  # i64 [V, V, len(LINK_FIELDS)]
+
+
+def check_link_params(params, n_vertices: int) -> None:
+    """Config-time guards for the link plane (engine constructors)."""
+    if not getattr(params, "link_telem", 0):
+        return
+    if int(params.link_telem) != 1:
+        raise ValueError(
+            f"link_telem={params.link_telem}: only the dense [V, V] "
+            f"accumulator (link_telem=1) is implemented; top-K edge "
+            f"tracking is reserved for a follow-up")
+    if n_vertices > MAX_DENSE_VERTICES:
+        raise ValueError(
+            f"link_telem: {n_vertices} vertices exceeds the dense "
+            f"accumulator bound ({MAX_DENSE_VERTICES}); the [V, V] tensor "
+            f"would not fit the observability budget")
+
+
+def link_init(link_telem: int, n_vertices: int) -> LinkAccum | None:
+    """A zeroed [V, V, F] accumulator, or None when the plane is off.
+
+    None contributes no pytree leaf, so a link-less state keeps the
+    historic leaf layout — checkpoints and sharding specs are unaffected
+    unless the plane is actually on."""
+    if not link_telem:
+        return None
+    import jax.numpy as jnp
+
+    return LinkAccum(
+        buf=jnp.zeros((int(n_vertices), int(n_vertices), len(LINK_FIELDS)),
+                      jnp.int64)
+    )
+
+
+def link_route_accum(links: LinkAccum, vs, vd, fmask, lost, linkdown,
+                     queued, wire) -> LinkAccum:
+    """Scatter one window's routed packets onto their edges (traced).
+
+    Called from ``route_outbox`` with the flat per-slot vectors it already
+    computed: ``vs``/``vd`` the endpoint vertices, ``fmask`` the occupied
+    slots (the offered population), ``lost``/``linkdown`` the drop masks
+    (subsets of fmask), ``queued`` the per-packet NIC queueing ns and
+    ``wire`` the wire bytes. Dead slots collapse onto edge (0, 0) with
+    all-zero contributions — a no-op by construction."""
+    import jax.numpy as jnp
+
+    buf = links.buf
+    v = buf.shape[0]
+    ek = jnp.where(fmask, vs.astype(jnp.int32) * v + vd.astype(jnp.int32), 0)
+    one = fmask.astype(jnp.int64)
+    q = jnp.where(fmask, queued, 0).astype(jnp.int64)
+    adds = jnp.stack([
+        one,                                    # pkts
+        jnp.where(fmask, wire, 0).astype(jnp.int64),
+        lost.astype(jnp.int64),
+        linkdown.astype(jnp.int64),
+        jnp.zeros_like(one),                    # nic drops accrue at tx sites
+        q,
+    ], axis=-1)                                 # [N, LINK_MAX_COL]
+    flat = buf.reshape(v * v, len(LINK_FIELDS))
+    flat = flat.at[ek, :LINK_MAX_COL].add(adds)
+    # max col: dead slots contribute max(old, 0) on edge 0 — a no-op,
+    # every entry is >= 0.
+    flat = flat.at[ek, LINK_MAX_COL].max(q)
+    return links._replace(buf=flat.reshape(buf.shape))
+
+
+def link_nic_drops(links: LinkAccum | None, ctx, drops, dst
+                   ) -> LinkAccum | None:
+    """Scatter NIC uplink drop-tail drops onto their egress edge (traced).
+
+    ``drops`` is the per-host drop count (bool mask or int counts, [H]
+    local hosts), ``dst`` the per-host GLOBAL destination host id (garbage
+    where drops == 0 — guarded here). No-op (and zero traced ops) when the
+    plane is off. Mirrors the ``nic_tx_drops`` metric sites exactly:
+    RED/AQM early drops are NOT backlog and stay off the edge tensor."""
+    if links is None:
+        return None
+    import jax.numpy as jnp
+
+    buf = links.buf
+    v = buf.shape[0]
+    n = drops.astype(jnp.int64)
+    hit = n > 0
+    vs = ctx.host_vertex[ctx.hosts]
+    vd = ctx.host_vertex[jnp.where(hit, dst, 0)]
+    ek = jnp.where(hit, vs.astype(jnp.int32) * v + vd.astype(jnp.int32), 0)
+    col = LINK_FIELDS.index("nic_backlog_drops")
+    flat = buf.reshape(v * v, len(LINK_FIELDS))
+    flat = flat.at[ek, col].add(jnp.where(hit, n, 0))
+    return links._replace(buf=flat.reshape(buf.shape))
+
+
+def drain_links(st, window_ns: int, start: int = 0) -> list[dict]:
+    """Host-side drain: cumulative per-edge snapshots at the current
+    window boundary (one device→host fetch; chunk boundaries only).
+
+    Emits one ``link`` record per edge with any nonzero column, in
+    (src, dst) order — running totals, so re-draining the same boundary
+    is idempotent and the ``start`` cursor (the last drained boundary)
+    guarantees resume never re-emits. A cursor REGRESSION (the state's
+    window count fell below ``start`` — a fleet lane rebound to a new
+    experiment mid-sweep) emits one ``link_gap`` rebase marker instead."""
+    links = getattr(st, "links", None)
+    if links is None:
+        return []
+    done = int(st.metrics.windows)
+    if done < start:
+        return [{
+            "type": REC_LINK_GAP,
+            "window": done,
+            "expected_window": start,
+        }]
+    if done <= start:
+        return []
+    buf = np.asarray(links.buf)
+    v = buf.shape[0]
+    t = round(done * window_ns / SEC, 9)
+    recs: list[dict] = []
+    for s, d in zip(*np.nonzero(buf.any(axis=-1))):
+        rec = {
+            "type": REC_LINK,
+            "window": done - 1,
+            "sim_time_s": t,
+            "src_vertex": int(s),
+            "dst_vertex": int(d),
+        }
+        rec.update({f: int(x) for f, x in zip(LINK_FIELDS, buf[s, d])})
+        recs.append(rec)
+    return recs

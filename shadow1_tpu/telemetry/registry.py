@@ -162,11 +162,25 @@ REC_SERVE_JOB = "serve_job"
 # Fleet rows add the ``exp`` id, same rule as ring records.
 REC_FLOW = "flow"
 REC_FLOW_GAP = "flow_gap"
+# Link-telemetry plane (telemetry/links.py, EngineParams.link_telem):
+# ``link`` = one CUMULATIVE per-edge snapshot per chunk boundary per active
+# (src_vertex, dst_vertex) edge — the LINK_FIELDS columns plus
+# window/sim_time_s/src_vertex/dst_vertex. Snapshots are running totals
+# (diff consecutive records per edge for rates), so a drain is a pure
+# function of device state and every engine's stream at the same boundary
+# is bit-identical (the digest-words argument). ``link_gap`` marks a
+# stream rebase: the window cursor regressed below the last drained
+# boundary (fleet lane rebind / mid-sweep lane lifecycle), so earlier
+# snapshots and later ones belong to different runs of the lane.
+# Fleet rows add the ``exp`` id, same rule as ring records.
+REC_LINK = "link"
+REC_LINK_GAP = "link_gap"
 RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
                 REC_FLEET_RETRY, REC_FLEET_QUARANTINE,
                 REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK,
-                REC_SERVE, REC_SERVE_JOB, REC_FLOW, REC_FLOW_GAP)
+                REC_SERVE, REC_SERVE_JOB, REC_FLOW, REC_FLOW_GAP,
+                REC_LINK, REC_LINK_GAP)
 
 # Serve-plane job-ledger namespace (shadow1_tpu/serve/daemon.py): exported
 # on the daemon's Prometheus endpoint (--metrics-port) with the
@@ -185,6 +199,12 @@ SERVE_SPECS: dict[str, tuple[str, str]] = {
     "cache_misses": (COUNTER, "hot-engine cache misses (trace + compile paid)"),
     "cache_evictions": (COUNTER, "hot-engine cache LRU evictions"),
     "cache_entries": (GAUGE, "compiled engines currently resident in the cache"),
+    # Link-telemetry roll-up (the result router watches ``link`` records as
+    # they demux into per-job result.jsonl streams): the hottest single
+    # edge seen across all tenants — cumulative wire bytes and total drops
+    # (loss + link_down + NIC backlog) of the busiest / lossiest edge.
+    "top_edge_bytes": (GAUGE, "wire bytes on the hottest edge seen (link records)"),
+    "top_edge_drops": (GAUGE, "drops on the lossiest edge seen (link records)"),
 }
 
 # The drop/overflow counter group: every way a modeled event or packet can
@@ -282,6 +302,32 @@ PROBE_FIELDS = (
     "nic_rx_bytes",       # lifetime wire bytes received by the host
     "pending_events",     # events queued at the host at the boundary
 )
+
+# ---------------------------------------------------------------------------
+# Link-telemetry column schema (consumed by telemetry/links.py, which owns
+# the jax side; declared here so tools/netreport.py stays jax-free). One
+# [V, V, F] i64 accumulator keyed (src_vertex, dst_vertex); every column is
+# a RUNNING TOTAL since sim start. ``pkts``/``bytes`` count packets OFFERED
+# to the edge at routing time (everything that reached an outbox slot —
+# the pkts_sent population; ob_overflow losses never reached an edge);
+# drop columns partition the offered packets that died on the edge;
+# ``queued_ns_*`` measure NIC serialization debt: depart − window_start of
+# the send window, per offered packet (values past the window length mean
+# the uplink is carrying backlog across windows — the saturation signal).
+# The first LINK_MAX_COL columns are additive (psum across shards / diff
+# across snapshots); ``queued_ns_max`` is a high-water gauge (max-reduced,
+# never diffed) — the fill-gauge rule.
+# ---------------------------------------------------------------------------
+LINK_FIELDS = (
+    "pkts",               # packets offered to the edge (routing time)
+    "bytes",              # wire bytes offered (payload + WIRE_OVERHEAD)
+    "loss_drops",         # path-loss draws lost on the edge
+    "link_down_drops",    # fault-plane outage drops on the edge
+    "nic_backlog_drops",  # NIC uplink drop-tail drops, egress-edge attributed
+    "queued_ns_sum",      # sum of per-packet NIC queueing (depart - win_start)
+    "queued_ns_max",      # high-water per-packet NIC queueing (gauge)
+)
+LINK_MAX_COL = LINK_FIELDS.index("queued_ns_max")
 
 
 def counter_names() -> tuple[str, ...]:

@@ -28,6 +28,7 @@ from shadow1_tpu.telemetry.registry import (
     REC_FLOW,
     REC_HEARTBEAT,
     REC_LINEAGE,
+    REC_LINK,
     REC_MEM,
     REC_RESUME,
     REC_RING,
@@ -616,6 +617,46 @@ def summarize(recs: list[dict], out=None) -> dict:
                   f"backlog_max {stats['nic_tx_backlog_ns_max']} ns"
                   f"{stall_txt}", file=out)
         summary["flows"] = fsum
+    link_recs = [r for r in recs if r.get("type") == REC_LINK]
+    if link_recs:
+        # Link-telemetry plane (--link-telem on): top edges by bytes and
+        # by drops plus the netreport verdicts. The full weathermap
+        # (heatmap, hottest path, per-edge series) is tools/netreport.py's
+        # job — this section is the triage index. Link rows are their own
+        # record type — like the flow/digest/retry records they never
+        # enter the ring percentile math above (only REC_RING rows rank).
+        from shadow1_tpu.tools.netreport import (
+            _fmt_edge,
+            diagnose_links,
+            edge_totals,
+            group_edges,
+        )
+
+        edges = group_edges(link_recs)
+        totals = edge_totals(edges)
+        verdicts = diagnose_links(edges)
+        lsum: dict = {"edges": len(totals),
+                      "verdicts": [v["kind"] for v in verdicts]}
+        print("== links (telemetry plane) ==", file=out)
+        by_bytes = sorted(totals.items(), key=lambda kv: -kv[1]["bytes"])
+        for key, t in by_bytes[:5]:
+            print(f"  {_fmt_edge(key)}: pkts {t['pkts']}  "
+                  f"bytes {t['bytes']}  drops {t['drops']}  "
+                  f"q_max {t['queued_ns_max']} ns", file=out)
+        droppy = [(k, t) for k, t in totals.items() if t["drops"]]
+        droppy.sort(key=lambda kv: -kv[1]["drops"])
+        for key, t in droppy[:5]:
+            if (key, t) in by_bytes[:5]:
+                continue
+            print(f"  {_fmt_edge(key)}: drops {t['drops']} "
+                  f"(loss {t['loss_drops']} down {t['link_down_drops']} "
+                  f"nic {t['nic_backlog_drops']})", file=out)
+        for v in verdicts:
+            detail = {k: x for k, x in v.items()
+                      if k not in ("kind", "edges")}
+            print(f"  VERDICT {v['kind']}: {', '.join(v['edges'])}  "
+                  f"{detail}", file=out)
+        summary["links"] = lsum
     return summary
 
 

@@ -90,19 +90,33 @@ class PcapWriter:
 
 
 class FilteredPcap:
-    """Watchlist filter in front of a PcapWriter (tools/pcapdump --host).
+    """Watchlist filter in front of a PcapWriter (tools/pcapdump --host /
+    --edge).
 
     ``watchlist`` is the probe plane's resolved (host, sock) tuple
     (config/experiment.resolve_watchlist — the same targets --watch
     accepts): a packet passes when its src OR dst endpoint matches an
-    entry; sock == -1 entries match every socket on the host. An empty
-    watchlist passes everything (filterless pcapdump unchanged).
+    entry; sock == -1 entries match every socket on the host.
+
+    ``edges`` is the link plane's resolved (src_vertex, dst_vertex) tuple
+    (config/experiment.resolve_edges — the same edges link records key
+    on), matched against the packet's attachment vertices via
+    ``host_vertex``: the pcap of a hot edge and its link-record stream
+    point at the same topology object. Directional, like link records.
+
+    Both filters empty passes everything (filterless pcapdump unchanged);
+    both given means EITHER may pass a packet (host-view OR edge-view).
     Drop-in for the CpuEngine ``capture`` hook — n_packets counts only
     what passed, like a capture filter on a real interface."""
 
-    def __init__(self, writer: PcapWriter, watchlist: tuple = ()):
+    def __init__(self, writer: PcapWriter, watchlist: tuple = (),
+                 edges: tuple = (), host_vertex=None):
         self.writer = writer
         self.watchlist = tuple(watchlist)
+        self.edges = tuple(edges)
+        if self.edges and host_vertex is None:
+            raise ValueError("edge filtering needs the host_vertex map")
+        self.host_vertex = host_vertex
 
     @property
     def n_packets(self) -> int:
@@ -112,12 +126,20 @@ class FilteredPcap:
         return any(h == host and (s < 0 or s == sock)
                    for h, s in self.watchlist)
 
+    def _match_edge(self, src: int, dst: int) -> bool:
+        vs = int(self.host_vertex[src])
+        vd = int(self.host_vertex[dst])
+        return (vs, vd) in self.edges
+
     def __call__(self, time_ns: int, src: int, dst: int, p: tuple,
                  dropped: bool) -> None:
-        if self.watchlist:
+        if self.watchlist or self.edges:
             packed = int(p[1])
             ss, ds = packed & 0xFF, (packed >> 8) & 0xFF
-            if not (self._match(src, ss) or self._match(dst, ds)):
+            ok = (self.watchlist
+                  and (self._match(src, ss) or self._match(dst, ds)))
+            ok = ok or (self.edges and self._match_edge(src, dst))
+            if not ok:
                 return
         self.writer(time_ns, src, dst, p, dropped)
 

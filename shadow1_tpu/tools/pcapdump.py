@@ -2,14 +2,17 @@
 capture every delivered packet to a .pcap file.
 
     python -m shadow1_tpu.tools.pcapdump config.yaml out.pcap [--windows N]
-        [--host NAME[:SOCK]]... [--sock N]
+        [--host NAME[:SOCK]]... [--sock N] [--edge VS:VD]...
 
 The capture engine is the sequential oracle (it sees every packet at
 routing time); for large configs bound the run with --windows. --host
 narrows the capture to packets touching the named endpoints — targets
 resolve exactly like the probe plane's --watch flag (config host names,
 group[i] / group-i members, numeric ids, optional :SOCK), so the pcap of
-a misbehaving flow and its probe stream point at the same entity.
+a misbehaving flow and its probe stream point at the same entity. --edge
+narrows it to packets crossing a topology edge (vertex names or ids,
+directional) — the same edges the link-telemetry records key on, so the
+pcap of a hot edge and its netreport row point at the same object.
 """
 
 from __future__ import annotations
@@ -31,6 +34,12 @@ def main(argv=None) -> int:
     ap.add_argument("--sock", type=int, default=None, metavar="N",
                     help="with --host entries that omit :SOCK, narrow them "
                          "to socket N")
+    ap.add_argument("--edge", action="append", default=None,
+                    metavar="VS:VD",
+                    help="capture only packets crossing this topology edge "
+                         "(repeatable; vertex names or numeric ids, "
+                         "directional — the namespace link records use). "
+                         "Combines with --host as OR")
     args = ap.parse_args(argv)
 
     import shadow1_tpu  # noqa: F401
@@ -40,6 +49,7 @@ def main(argv=None) -> int:
     from shadow1_tpu.config.experiment import (
         WatchlistError,
         load_experiment,
+        resolve_edges,
         resolve_watchlist,
     )
     from shadow1_tpu.cpu_engine import CpuEngine
@@ -55,10 +65,14 @@ def main(argv=None) -> int:
                        else f"{h}:{args.sock}" for h in args.host]
             watchlist = resolve_watchlist(entries, exp.dns,
                                           params.sockets_per_host)
+        edges: tuple = ()
+        if args.edge:
+            edges = resolve_edges(args.edge, exp.vertex_names)
     except WatchlistError as e:
         ap.error(str(e))
     with FilteredPcap(PcapWriter(args.out, snaplen=args.snaplen),
-                      watchlist) as w:
+                      watchlist, edges=edges,
+                      host_vertex=exp.host_vertex) as w:
         eng = CpuEngine(exp, params, capture=w)
         m = eng.run(n_windows=args.windows)
         print(f"{w.n_packets} packets captured to {args.out}; metrics: {m}")

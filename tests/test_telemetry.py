@@ -61,6 +61,31 @@ def test_registry_in_sync_with_engine_metrics():
     assert set(RING_COUNTERS) <= set(METRIC_SPECS)
 
 
+def test_registry_link_schema_pinned():
+    """The link-record schema is part of the sync contract: the oracle and
+    the traced scatter address columns by position, so the declared order
+    is load-bearing — a reorder is a schema break, not a refactor."""
+    from shadow1_tpu.telemetry.registry import (
+        LINK_FIELDS,
+        LINK_MAX_COL,
+        RECORD_TYPES,
+        REC_LINK,
+        REC_LINK_GAP,
+        SERVE_SPECS,
+    )
+
+    assert REC_LINK in RECORD_TYPES and REC_LINK_GAP in RECORD_TYPES
+    assert LINK_FIELDS == (
+        "pkts", "bytes", "loss_drops", "link_down_drops",
+        "nic_backlog_drops", "queued_ns_sum", "queued_ns_max")
+    # The gauge column is last: the additive prefix buf[..., :LINK_MAX_COL]
+    # is what shard/engine.py psums; the max column pmax-reduces.
+    assert LINK_MAX_COL == len(LINK_FIELDS) - 1
+    # The serve ledger exports the hot-edge gauges under SERVE_SPECS.
+    assert SERVE_SPECS["top_edge_bytes"][0] == "gauge"
+    assert SERVE_SPECS["top_edge_drops"][0] == "gauge"
+
+
 def test_normalize_fills_missing_and_keeps_extras():
     d = normalize({"events": 7, "custom_counter": 3})
     assert d["events"] == 7
