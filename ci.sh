@@ -363,7 +363,7 @@ assert sb["experiments"] == 4 and sb["streams_compared"] == 4, sb
 print("memprobe: 4-lane sweep sub-batched (3+1) bit-identical per lane,",
       sb["windows"], "windows")
 '
-    echo "== serve-plane smoke (daemon round-trip: cache hit + admission + digest parity) =="
+    echo "== serve-plane smoke (daemon round-trip: cache hit + admission + digest parity + resilience) =="
     # The serve acceptance gates (ISSUE 14 / docs/SEMANTICS.md §"Serving
     # contract"), all in one probe: spawn a real daemon on CPU, submit two
     # same-shape jobs SEQUENTIALLY (second batch must be an engine-cache
@@ -371,33 +371,56 @@ print("memprobe: 4-lane sweep sub-batched (3+1) bit-identical per lane,",
     # be rejected pre-compile with the memory_budget advice record and
     # EXIT_MEMORY while the others run), bit-compare both completed jobs'
     # digest streams against solo CLI runs, and SIGTERM-drain the daemon
-    # (EXIT_SERVE_SHUTDOWN).
+    # (EXIT_SERVE_SHUTDOWN). --resilience then runs a SECOND daemon under
+    # a squeezed budget (ISSUE 19): one tenant parks in waiting_headroom
+    # and later completes bit-exact, a depth-2 queue rejects the fourth
+    # submit with queue_full + retry_after_s advice, a --queue-ttl-s
+    # tenant expires with deadline_expired, and an injected transient
+    # crash is retried to a bit-exact finish.
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.serveprobe \
         configs/serve_phold.yaml --seeds 5,6 \
         --overbudget configs/mem_overbudget.yaml --mem-bytes $((8<<30)) \
-        --json-only 2>/dev/null | python -c '
+        --resilience --json-only 2>/dev/null | python -c '
 import json, sys
 d = json.loads(sys.stdin.read().strip().splitlines()[-1])
 assert d["ok"], d
 assert d["jobs"] == 2 and d["cache_hits"] >= 1, d
 assert d["rejected_overbudget"] is True, d
 assert all(n >= 40 for n in d["windows_compared"].values()), d
+r = d["resilience"]
+assert r["waiting_headroom"] and r["queue_full"], r
+assert r["queue_ttl_expired"] and r["transient_retried"], r
+assert r["bit_exact_jobs"] == 3, r
 print("serveprobe: 2 jobs bit-identical to solo,", d["cache_hits"],
       "cache hit(s) (no recompile), over-budget job rejected with advice,",
       "daemon drained rc", d["shutdown_rc"])
+print("serveprobe --resilience: waiting_headroom + queue_full +",
+      "queue-TTL expiry + transient retry,", r["bit_exact_jobs"],
+      "jobs bit-identical over", r["windows_compared"], "windows")
 '
-    # Kill-during-submit chaos: SIGKILL the daemon at a random offset
-    # after a submission (covers mid-accept), assert NO torn spool record
-    # (the write_json_atomic / atomic-move contract), restart, and the
-    # job must complete bit-identical to the solo run.
+    # Kill-anywhere chaos for the serve plane: SIGKILL the daemon and
+    # assert NO torn spool record (the write_json_atomic / atomic-move
+    # contract), restart, and every surviving job must complete
+    # bit-identical to the solo run. Beyond the two random-offset kills
+    # (covering mid-accept), three aimed kills land at the resilience
+    # states of ISSUE 19: a tenant parked in waiting_headroom, a batch
+    # inside its retry-backoff window, and just after a queue-TTL expiry
+    # (whose terminal deadline_expired record must survive the restart).
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m shadow1_tpu.tools.chaosprobe \
-        configs/serve_phold.yaml --serve 2 --seed 3 --json-only 2>/dev/null | python -c '
+        configs/serve_phold.yaml --serve 5 --seed 3 \
+        --serve-kinds random,random,waiting_headroom,retry_backoff,deadline \
+        --json-only 2>/dev/null | python -c '
 import json, sys
 d = json.loads(sys.stdin.read().strip().splitlines()[-1])
-assert d["ok"] and d["trials"] == 2, d
+assert d["ok"] and d["trials"] == 5, d
 assert d["torn_records"] == [], d
-print("chaosprobe --serve:", d["trials"], "daemon-kill trials, no torn",
-      "records, jobs bit-identical to solo")
+assert all(v["ok"] for v in d["verdicts"]), d
+kinds = [v["kind"] for v in d["verdicts"]]
+assert kinds == ["random", "random", "waiting_headroom",
+                 "retry_backoff", "deadline"], kinds
+print("chaosprobe --serve:", d["trials"], "daemon-kill trials",
+      "(2 random + waiting_headroom + retry_backoff + deadline),",
+      "no torn records, jobs bit-identical to solo")
 '
     echo "== bench regression gate (BENCH_GATE.json, ms/round per row) =="
     # ROADMAP item 5: the gate now carries THREE rows — dense smoke PHOLD,

@@ -51,10 +51,24 @@ EXIT_SERVE_SHUTDOWN = 8  # serve plane (shadow1_tpu/serve/): the daemon
 EXIT_SERVE_SPOOL = 9  # serve plane: the daemon REFUSED to start — the
                      # --spool directory is unusable (unwritable, torn
                      # beyond repair) or another live daemon already owns
-                     # it (daemon.json names a running pid). Job
-                     # submissions never use this code: a rejected job
-                     # exits the submit client with EXIT_CONFIG /
-                     # EXIT_MEMORY like the solo CLI would
+                     # it (flock held, or daemon.json names a live holder
+                     # under the heartbeat/pid stale-lock protocol; a
+                     # SIGKILLed holder's leftovers classify stale and
+                     # are reclaimed instead). Job submissions never use
+                     # this code: a rejected job exits the submit client
+                     # with EXIT_CONFIG / EXIT_MEMORY like the solo CLI
+EXIT_QUEUE_FULL = 10  # serve plane backpressure: the job FITS an idle
+                     # device but the daemon's bounded queue (--queue-depth
+                     # / --queue-bytes) is at capacity — structured
+                     # ``error=queue_full`` rejection carrying
+                     # ``retry_after_s`` advice; resubmit after backing
+                     # off (never a silent drop, never an OOM for the
+                     # tenants already running)
+EXIT_DEADLINE = 11   # serve plane deadlines: the job expired — either
+                     # still waiting past --queue-ttl-s, or running past
+                     # --deadline-s (drained at a chunk boundary; the
+                     # result stream keeps the committed prefix, bit-
+                     # identical to the same prefix of a straight run)
 
 EXIT_CODES: dict[int, str] = {
     EXIT_OK: "ok",
@@ -65,6 +79,8 @@ EXIT_CODES: dict[int, str] = {
     EXIT_MEMORY: "memory (over HBM budget / RESOURCE_EXHAUSTED, advice printed)",
     EXIT_SERVE_SHUTDOWN: "serve daemon drained (queue persisted; restart to resume)",
     EXIT_SERVE_SPOOL: "serve daemon refused to start (spool unusable or owned)",
+    EXIT_QUEUE_FULL: "serve queue full (backpressure; retry_after_s advice printed)",
+    EXIT_DEADLINE: "serve deadline expired (queue TTL or running --deadline-s)",
 }
 
 # --------------------------------------------------------------------------

@@ -32,7 +32,11 @@ Spool layout (``--spool DIR``)::
       serve.log               the daemon's own JSONL event stream
                               (REC_SERVE / REC_SERVE_JOB records —
                               tools/heartbeat_report.py's serve section)
-      daemon.json             daemon liveness: pid / socket path / start
+      daemon.json             daemon liveness: host / pid / socket path /
+                              start / heartbeat (mtime refreshed every
+                              HEARTBEAT_S — the stale-lock protocol)
+      daemon.lock             fcntl flock held for the daemon's lifetime
+                              (kernel-released on death; never parsed)
       serve.sock              the Unix socket
 
 Deliberately jax-free: the client, report tools and tests import this
@@ -41,6 +45,7 @@ without paying an accelerator import.
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import socket
@@ -52,15 +57,32 @@ SPOOL_VERSION = 1
 
 # Job lifecycle states (the serve_job records' ``state`` field).
 J_QUEUED = "queued"      # admitted; waiting for a lane
+J_WAITING = "waiting_headroom"  # admitted (fits an idle device) but the
+#                          resident batch leaves too little live headroom;
+#                          scheduled as soon as resident bytes drain —
+#                          never rejected just because someone else runs
 J_RUNNING = "running"    # riding a lane of the in-flight fleet batch
 J_DONE = "done"          # finished; final fleet_exp in result.jsonl
-J_FAILED = "failed"      # quarantined lane / runtime error (detail says)
-J_REJECTED = "rejected"  # refused at admission (config / memory budget)
+J_FAILED = "failed"      # quarantined lane / runtime error / deadline
+#                          expiry / retries exhausted (reason says)
+J_REJECTED = "rejected"  # refused at admission (config / memory budget /
+#                          queue_full backpressure)
 J_EVICTED = "evicted"    # preempted by a higher-priority tenant;
 #                          automatically requeued (transient state —
 #                          the job returns to queued with its batch
 #                          checkpoint as the resume cursor)
 TERMINAL_STATES = (J_DONE, J_FAILED, J_REJECTED)
+
+# Spool-lock liveness protocol (NFS-safe ownership). The daemon holds an
+# fcntl flock on DIR/daemon.lock for its whole lifetime — on one host,
+# kernel lock release on process death makes takeover race-free. Across
+# hosts (an NFS spool where flock may not propagate) daemon.json's
+# host/pid plus a heartbeat (the daemon touches daemon.json's mtime every
+# HEARTBEAT_S) decide: same host → the pid check is authoritative;
+# different host → a heartbeat older than STALE_AFTER_S marks the holder
+# dead and the spool reclaimable.
+HEARTBEAT_S = 5.0
+STALE_AFTER_S = 30.0
 
 
 def new_job_id() -> str:
@@ -79,6 +101,7 @@ class Spool:
         self.queue_path = os.path.join(root, "queue.json")
         self.log_path = os.path.join(root, "serve.log")
         self.daemon_path = os.path.join(root, "daemon.json")
+        self.lock_path = os.path.join(root, "daemon.lock")
         self.sock_path = os.path.join(root, "serve.sock")
 
     def ensure(self) -> "Spool":
@@ -170,7 +193,7 @@ class Spool:
         except OSError:
             return []
 
-    # -- daemon liveness ---------------------------------------------------
+    # -- daemon liveness / spool ownership ---------------------------------
 
     def daemon_info(self) -> dict | None:
         try:
@@ -179,18 +202,68 @@ class Spool:
         except (OSError, ValueError):
             return None
 
-    def daemon_alive(self) -> dict | None:
-        """The live daemon's info record, or None. Stale daemon.json
-        (dead pid — a SIGKILLed daemon can't clean up) reads as absent,
-        so a restart can always take the spool over."""
+    def acquire_lock(self) -> int | None:
+        """Take the spool's fcntl lock (DIR/daemon.lock) non-blocking;
+        returns the held fd — the caller keeps it open for the daemon's
+        lifetime (the kernel releases it on ANY process death, including
+        SIGKILL) — or None when a live same-host daemon already holds it.
+        Holding the flock alone is not ownership: an NFS holder on
+        another host may not be visible through flock, so the caller must
+        still consult :meth:`holder_liveness` before reclaiming."""
+        fd = os.open(self.lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return None
+        return fd
+
+    def holder_liveness(self, stale_after_s: float = STALE_AFTER_S
+                        ) -> tuple[str, dict | None]:
+        """('absent'|'live'|'stale', daemon.json info) — the heartbeat /
+        pid stale-lock protocol. Same host: a dead pid is stale no matter
+        how fresh the heartbeat (a SIGKILLed daemon can't clean up); a
+        live pid counts only with a fresh heartbeat, guarding against pid
+        recycling. Different host (NFS spool): the heartbeat mtime is the
+        only signal — fresh means live, stale means reclaimable."""
         info = self.daemon_info()
         if not info:
-            return None
+            return "absent", None
+        hb = 0.0
+        for key in ("heartbeat_at", "started_at"):
+            try:
+                hb = max(hb, float(info.get(key) or 0))
+            except (TypeError, ValueError):
+                pass
         try:
-            os.kill(int(info["pid"]), 0)
-        except (OSError, ValueError, KeyError):
-            return None
-        return info
+            hb = max(hb, os.path.getmtime(self.daemon_path))
+        except OSError:
+            pass
+        fresh = (time.time() - hb) < stale_after_s
+        same_host = info.get("host") in (None, socket.gethostname())
+        if same_host:
+            try:
+                os.kill(int(info["pid"]), 0)
+            except (OSError, ValueError, KeyError, TypeError):
+                return "stale", info
+            return ("live" if fresh else "stale"), info
+        return ("live" if fresh else "stale"), info
+
+    def touch_heartbeat(self) -> None:
+        """Refresh the liveness heartbeat (daemon.json's mtime — the
+        cross-host half of the stale-lock protocol)."""
+        try:
+            os.utime(self.daemon_path)
+        except OSError:
+            pass
+
+    def daemon_alive(self) -> dict | None:
+        """The live daemon's info record, or None. Stale daemon.json
+        (dead pid, or a heartbeat past STALE_AFTER_S — a SIGKILLed
+        daemon can't clean up) reads as absent, so a restart can always
+        take the spool over."""
+        liveness, info = self.holder_liveness()
+        return info if liveness == "live" else None
 
 
 # ---------------------------------------------------------------------------

@@ -152,6 +152,18 @@ REC_WORK = "work"
 # stay out of ring percentile math by being their own record types.
 REC_SERVE = "serve"
 REC_SERVE_JOB = "serve_job"
+# Serve resilience planes (docs/OBSERVABILITY.md §"Serve records"):
+# ``serve_queue`` = admission backpressure events (enqueue /
+# waiting_headroom / reject_full) each with the queue's depth, queued
+# est_peak bytes and oldest-wait age at that instant; ``serve_deadline``
+# = one record per expiry (kind = queue_ttl | running — a running expiry
+# names the committed-prefix checkpoint and ran_s); ``serve_retry`` = the
+# transient-failure retry plane (event = retry | bisect | exhausted, with
+# the batch, job list, attempt count and backoff). All daemon-level, out
+# of ring percentile math like every serve record.
+REC_SERVE_QUEUE = "serve_queue"
+REC_SERVE_DEADLINE = "serve_deadline"
+REC_SERVE_RETRY = "serve_retry"
 # Flow-probe plane (telemetry/probes.py, EngineParams.probes): ``flow`` =
 # one per-window sample of one watched (host, sock) entity — the PROBE_FIELDS
 # columns plus window/sim_time_s/host/sock (sock −1 = host-only view). The
@@ -179,7 +191,9 @@ RECORD_TYPES = (REC_HEARTBEAT, REC_TRACKER, REC_RING, REC_RING_GAP,
                 REC_DIGEST, REC_FLEET_EXP, REC_FLEET_SUMMARY,
                 REC_FLEET_RETRY, REC_FLEET_QUARANTINE,
                 REC_RESUME, REC_LINEAGE, REC_MEM, REC_WORK,
-                REC_SERVE, REC_SERVE_JOB, REC_FLOW, REC_FLOW_GAP,
+                REC_SERVE, REC_SERVE_JOB, REC_SERVE_QUEUE,
+                REC_SERVE_DEADLINE, REC_SERVE_RETRY,
+                REC_FLOW, REC_FLOW_GAP,
                 REC_LINK, REC_LINK_GAP)
 
 # Serve-plane job-ledger namespace (shadow1_tpu/serve/daemon.py): exported
@@ -193,7 +207,15 @@ SERVE_SPECS: dict[str, tuple[str, str]] = {
     "jobs_failed": (COUNTER, "jobs failed (quarantined lane / runtime error)"),
     "jobs_evicted": (COUNTER, "job evictions (priority preemption drains)"),
     "jobs_queued": (GAUGE, "jobs waiting in the lane-packing queue"),
+    "jobs_waiting": (GAUGE, "jobs in waiting_headroom (fit idle, not live)"),
     "jobs_running": (GAUGE, "jobs in the in-flight fleet batch"),
+    "queue_depth": (GAUGE, "admitted jobs waiting (queued + waiting_headroom)"),
+    "queue_bytes": (GAUGE, "est_peak bytes of every waiting job, summed"),
+    "oldest_wait_s": (GAUGE, "age of the oldest waiting job"),
+    "jobs_queue_full": (COUNTER, "queue_full rejections (backpressure caps)"),
+    "jobs_expired": (COUNTER, "deadline expiries (queue TTL + running)"),
+    "batch_retries": (COUNTER, "transient-failure batch retries (backoff)"),
+    "jobs_bisected": (COUNTER, "jobs split into solo batches after repeat crashes"),
     "batches_run": (COUNTER, "fleet batches executed"),
     "cache_hits": (COUNTER, "hot-engine cache hits (compile skipped)"),
     "cache_misses": (COUNTER, "hot-engine cache misses (trace + compile paid)"),
@@ -375,14 +397,18 @@ def to_prometheus(metrics: dict, prefix: str = "shadow1",
     lines = []
     table = METRIC_SPECS if specs is None else specs
     rows = normalize(metrics) if specs is None else \
-        {**{n: int(metrics.get(n, 0)) for n in table},
+        {**{n: metrics.get(n, 0) for n in table},
          **{k: v for k, v in metrics.items() if k not in table}}
     for name, value in rows.items():
         kind, help_ = table.get(name, (COUNTER, "engine-specific counter"))
         metric = f"{prefix}_{name}" + ("_total" if kind == COUNTER else "")
         lines.append(f"# HELP {metric} {_escape_help(help_)}")
         lines.append(f"# TYPE {metric} {kind}")
-        lines.append(f"{metric}{lab} {int(value)}")
+        # Integral values print as integers; fractional gauges (wait-time
+        # seconds) keep their fraction — int() would floor a sub-second
+        # queue wait to a lying zero.
+        v = float(value or 0)
+        lines.append(f"{metric}{lab} {int(v) if v == int(v) else v}")
     return "\n".join(lines) + "\n"
 
 

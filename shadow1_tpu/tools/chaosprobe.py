@@ -73,6 +73,7 @@ MAX_LAUNCHES = 8
 RANDOM_KINDS = ("sigkill_group", "sigterm_group",
                 "sigkill_child", "sigterm_child")
 SPECIAL_KINDS = ("drain", "torn_head", "corrupt_head", "mid_write")
+SERVE_KINDS = ("random", "waiting_headroom", "retry_backoff", "deadline")
 
 
 def _child_pids(ppid: int) -> list[int]:
@@ -166,12 +167,31 @@ def _serve_trials(args) -> int:
     the same spool → the job must complete with a digest stream
     bit-identical to the solo CLI run (a from-scratch rerun is
     bit-identical by determinism; a finished job survives untouched).
-    The final daemon is SIGTERM-drained (EXIT_SERVE_SHUTDOWN checked)."""
+    The final daemon is SIGTERM-drained (EXIT_SERVE_SHUTDOWN checked).
+
+    ``--serve-kinds`` picks the lifecycle instant per trial (cycled):
+
+    * ``random`` — the classic random-offset kill above;
+    * ``waiting_headroom`` — a tight memory budget parks a second tenant
+      in waiting_headroom behind a long resident batch; the daemon is
+      SIGKILLed in exactly that state — both tenants must complete
+      bit-identically after the restart;
+    * ``retry_backoff`` — an injected transient crash (countdown file)
+      puts the batch into its backoff window; the kill lands mid-backoff
+      and the restarted daemon (one crash left on the counter) must
+      crash once more, retry, and finish bit-exact;
+    * ``deadline`` — a --queue-ttl-s tenant expires mid-run, THEN the
+      kill lands: the terminal deadline_expired record must survive the
+      restart and the surviving tenant must complete bit-exact."""
     import shadow1_tpu  # noqa: F401
     from shadow1_tpu.consts import EXIT_SERVE_SHUTDOWN
     from shadow1_tpu.serve import client
     from shadow1_tpu.serve.protocol import Spool
-    from shadow1_tpu.tools.serveprobe import _served_stream, _solo_stream
+    from shadow1_tpu.tools.serveprobe import (
+        _served_stream,
+        _solo_stream,
+        _wait_state,
+    )
 
     rng = random.Random(args.seed)
     work = tempfile.mkdtemp(prefix="chaosserve_")
@@ -179,6 +199,14 @@ def _serve_trials(args) -> int:
         lambda *a: print(*a, file=sys.stderr, flush=True))
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    kinds = [k.strip() for k in
+             (args.serve_kinds or "random").split(",") if k.strip()]
+    unknown = [k for k in kinds if k not in SERVE_KINDS]
+    if unknown:
+        print(json.dumps({"ok": False, "error":
+                          f"unknown --serve-kinds {unknown}; "
+                          f"pick from {list(SERVE_KINDS)}"}))
+        return 1
 
     ref = _solo_stream(args.config, args.windows, args.timeout_s, env)
     if not ref:
@@ -187,13 +215,25 @@ def _serve_trials(args) -> int:
                           "engine metrics_ring + state_digest"}))
         return 1
     say(f"[chaosprobe --serve] solo reference: {len(ref)} digest rows")
+    est = 0
+    if "waiting_headroom" in kinds:
+        from shadow1_tpu import mem
+        from shadow1_tpu.config.experiment import load_experiment
 
-    def spawn(spool, err_path):
+        exp, params, _ = load_experiment(args.config)
+        est = mem.estimate(exp, params, n_exp=1).peak_bytes
+        if est <= 0:
+            print(json.dumps({"ok": False, "error": "memory estimator "
+                              "returned no estimate for the config — "
+                              "waiting_headroom trials need one"}))
+            return 1
+
+    def spawn(spool, err_path, trial_env, extra=()):
         ef = open(err_path, "a")
         p = subprocess.Popen(
             [sys.executable, "-m", "shadow1_tpu", "serve",
-             "--spool", spool, "--poll-s", "0.05"],
-            env=env, stdout=subprocess.DEVNULL, stderr=ef)
+             "--spool", spool, "--poll-s", "0.05", *extra],
+            env=trial_env, stdout=subprocess.DEVNULL, stderr=ef)
         deadline = time.monotonic() + 60
         while Spool(spool).daemon_alive() is None:
             if p.poll() is not None or time.monotonic() > deadline:
@@ -204,20 +244,73 @@ def _serve_trials(args) -> int:
     verdicts = []
     torn = []
     for ti in range(args.serve):
+        kind = kinds[ti % len(kinds)]
         spool = os.path.join(work, f"t{ti}")
         errp = os.path.join(work, f"t{ti}.stderr")
         # Any trial-infrastructure failure (daemon won't start, SIGTERM
         # wait expires, spool IO) must still end in the JSON verdict
         # contract (ci.sh parses the last stdout line), never a raw
         # traceback with empty stdout.
-        p = ef = jid = None
+        p = ef = None
         rc = None
-        final = {}
+        setup_err = None
+        # (job_id, expected_state, bit_compare) per tenant of the trial
+        jobs: list[tuple[str, str, bool]] = []
+        finals: dict[str, dict] = {}
+        env1 = env2 = dict(env)
+        extra1 = extra2 = ()
         try:
-            p, ef = spawn(spool, errp)
-            jid = client.submit(spool, args.config)
-            # Kill offset sweeps the whole lifecycle: ~0 = mid-accept.
-            time.sleep(rng.uniform(0.0, args.serve_kill_s))
+            if kind == "waiting_headroom":
+                env1 = env2 = {**env,
+                               "SHADOW1_MEM_BYTES": str(int(est * 1.5))}
+            elif kind == "retry_backoff":
+                crash = os.path.join(work, f"t{ti}.crash")
+                with open(crash, "w") as f:
+                    f.write("2")  # one crash per daemon incarnation
+                env1 = {**env, "SHADOW1_SERVE_CRASH_BATCH": crash,
+                        "SHADOW1_SERVE_RETRY_BACKOFF_S": "3.0"}
+                env2 = {**env, "SHADOW1_SERVE_CRASH_BATCH": crash,
+                        "SHADOW1_SERVE_RETRY_BACKOFF_S": "0"}
+                extra1 = extra2 = ("--ckpt-every-s", "0.05")
+            p, ef = spawn(spool, errp, env1, extra1)
+            if kind == "waiting_headroom":
+                j_a = client.submit(spool, args.config, windows=300)
+                jobs.append((j_a, "done", True))
+                if _wait_state(spool, j_a, ("running",), 120) is None:
+                    raise RuntimeError("resident batch never ran")
+                j_b = client.submit(spool, args.config)
+                jobs.append((j_b, "done", True))
+                if _wait_state(spool, j_b, ("waiting_headroom",),
+                               120) is None:
+                    raise RuntimeError("tenant never reached "
+                                       "waiting_headroom")
+            elif kind == "retry_backoff":
+                jid = client.submit(spool, args.config)
+                jobs.append((jid, "done", True))
+                deadline = time.monotonic() + 120
+                while True:  # kill lands INSIDE the 3s backoff window
+                    st = Spool(spool).read_status(jid) or {}
+                    if st.get("retrying"):
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("batch never entered retry "
+                                           "backoff")
+                    time.sleep(0.05)
+            elif kind == "deadline":
+                j1 = client.submit(spool, args.config, windows=300)
+                jobs.append((j1, "done", True))
+                if _wait_state(spool, j1, ("running",), 120) is None:
+                    raise RuntimeError("resident batch never ran")
+                j2 = client.submit(spool, args.config, priority=-1,
+                                   queue_ttl_s=0.05)
+                jobs.append((j2, "failed", False))
+                st = _wait_state(spool, j2, ("failed",), 120)
+                if st is None or st.get("reason") != "deadline_expired":
+                    raise RuntimeError(f"TTL tenant did not expire: {st}")
+            else:  # random: the kill offset sweeps the whole lifecycle
+                jid = client.submit(spool, args.config)
+                jobs.append((jid, "done", True))
+                time.sleep(rng.uniform(0.0, args.serve_kill_s))
             p.kill()
             p.wait()
             ef.close()
@@ -232,39 +325,60 @@ def _serve_trials(args) -> int:
                             json.load(f)
                     except ValueError:
                         torn.append(os.path.relpath(fp, spool))
-            p, ef = spawn(spool, errp)
+            p, ef = spawn(spool, errp, env2, extra2)
             try:
-                final = client.await_job(Spool(spool), jid,
-                                         timeout_s=args.timeout_s,
-                                         poll_s=0.05)
-            except TimeoutError as e:
-                final = {"state": f"timeout: {e}"}
+                for jid, expect, _cmp in jobs:
+                    if expect == "done":
+                        try:
+                            finals[jid] = client.await_job(
+                                Spool(spool), jid,
+                                timeout_s=args.timeout_s, poll_s=0.05)
+                        except TimeoutError as e:
+                            finals[jid] = {"state": f"timeout: {e}"}
+                    else:
+                        finals[jid] = Spool(spool).read_status(jid) or {}
             finally:
                 p.send_signal(signal.SIGTERM)
                 rc = p.wait(timeout=60)
         except (RuntimeError, OSError,
                 subprocess.TimeoutExpired) as e:
-            final = final or {"state": f"error: {e}"}
+            setup_err = str(e)
         finally:
             if p is not None and p.poll() is None:
                 p.kill()
                 p.wait()
             if ef is not None:
                 ef.close()
-        served = _served_stream(spool, jid) if jid is not None else {}
-        common = sorted(set(served) & set(ref))
-        bad = [w for w in common if served[w] != ref[w]]
-        ok = (final.get("state") == "done" and not bad and common
-              and rc == EXIT_SERVE_SHUTDOWN)
-        verdicts.append({"trial": ti, "state": final.get("state"),
-                         "windows_compared": len(common),
-                         "first_divergence": bad[:1],
-                         "shutdown_rc": rc, "ok": bool(ok)})
-        say(f"[chaosprobe --serve] trial {ti}: {final.get('state')}, "
-            f"{len(common)} windows vs solo"
-            + (" — DIVERGED" if bad else ", bit-identical"))
+        states_ok = bool(jobs) and setup_err is None and all(
+            (finals.get(jid) or {}).get("state") == expect
+            for jid, expect, _cmp in jobs)
+        compared = 0
+        bad = []
+        for jid, _expect, cmp_ in jobs:
+            if not cmp_:
+                continue
+            served = _served_stream(spool, jid)
+            common = sorted(set(served) & set(ref))
+            compared += len(common)
+            bad += [w for w in common if served[w] != ref[w]]
+            if not common:
+                states_ok = False
+        ok = states_ok and not bad and rc == EXIT_SERVE_SHUTDOWN
+        verdicts.append({
+            "trial": ti, "kind": kind,
+            "states": {j: (finals.get(j) or {}).get("state")
+                       for j, _e, _c in jobs},
+            "windows_compared": compared,
+            "first_divergence": bad[:1],
+            "error": setup_err,
+            "shutdown_rc": rc, "ok": bool(ok)})
+        say(f"[chaosprobe --serve] trial {ti} ({kind}): "
+            + (f"ERROR {setup_err}" if setup_err else
+               f"{compared} windows vs solo"
+               + (" — DIVERGED" if bad else ", bit-identical")))
     ok = not torn and all(v["ok"] for v in verdicts)
     print(json.dumps({"ok": ok, "trials": args.serve,
+                      "kinds": kinds,
                       "torn_records": torn, "verdicts": verdicts}))
     if torn or not ok:
         return EXIT_DIVERGED if any(v["first_divergence"]
@@ -310,6 +424,13 @@ def main(argv=None) -> int:
                          "engine metrics_ring + state_digest)")
     ap.add_argument("--serve-kill-s", type=float, default=2.0,
                     help="--serve: max random kill offset after submit")
+    ap.add_argument("--serve-kinds", default="random", metavar="K,K,...",
+                    help="--serve: comma list cycled across trials — "
+                         f"{', '.join(SERVE_KINDS)}. The non-random "
+                         "kinds aim the SIGKILL at a specific lifecycle "
+                         "instant (tenant parked in waiting_headroom, "
+                         "batch inside its retry-backoff window, just "
+                         "after a queue-TTL expiry)")
     ap.add_argument("--timeout-s", type=float, default=600.0,
                     help="per-launch wall timeout")
     ap.add_argument("--json-only", action="store_true")

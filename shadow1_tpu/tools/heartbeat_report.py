@@ -34,7 +34,10 @@ from shadow1_tpu.telemetry.registry import (
     REC_RING,
     REC_RING_GAP,
     REC_SERVE,
+    REC_SERVE_DEADLINE,
     REC_SERVE_JOB,
+    REC_SERVE_QUEUE,
+    REC_SERVE_RETRY,
     REC_TRACKER,
     REC_WORK,
     RING_COUNTERS,
@@ -430,17 +433,45 @@ def summarize(recs: list[dict], out=None) -> dict:
             by_job.setdefault(r.get("job", "?"), []).append(r)
         batches = [r for r in serve_ev if r.get("event") == "batch_start"]
         evicts = [r for r in serve_ev if r.get("event") == "evict"]
+        deadlines = [r for r in recs
+                     if r.get("type") == REC_SERVE_DEADLINE]
+        retries = [r for r in recs if r.get("type") == REC_SERVE_RETRY]
         cache = {"hit": 0, "miss": 0}
         for b in batches:
             if b.get("cache") in cache:
                 cache[b["cache"]] += 1
+        # Queue wait = admission → first batch_start, read off each job's
+        # own status transitions (the spool timeline, not the ledger):
+        # the backpressure plane's effect as tenants actually felt it.
+        waits = []
+        for rows in by_job.values():
+            q_t = next((r.get("t") for r in rows
+                        if r.get("state") in ("queued",
+                                              "waiting_headroom")), None)
+            r_t = next((r.get("t") for r in rows
+                        if r.get("state") == "running"), None)
+            if q_t is not None and r_t is not None and r_t >= q_t:
+                waits.append(r_t - q_t)
+        retry_by_job: dict[str, int] = {}
+        for r in retries:
+            if r.get("event") == "retry":
+                for j in r.get("jobs", []):
+                    retry_by_job[j] = retry_by_job.get(j, 0) + 1
         ssum = {
             "jobs": len(by_job),
             "batches": len(batches),
             "cache_hits": cache["hit"],
             "cache_misses": cache["miss"],
             "evictions": len(evicts),
+            "deadline_expiries": len(deadlines),
+            "batch_retries": sum(1 for r in retries
+                                 if r.get("event") == "retry"),
         }
+        if waits:
+            ssum["queue_wait_p50_s"] = round(percentile(waits, 50), 3)
+            ssum["queue_wait_p95_s"] = round(percentile(waits, 95), 3)
+        if retry_by_job:
+            ssum["retries_by_job"] = retry_by_job
         shutdown = next((r for r in reversed(serve_ev)
                          if r.get("event") == "shutdown"), None)
         if shutdown and isinstance(shutdown.get("ledger"), dict):
@@ -450,6 +481,23 @@ def summarize(recs: list[dict], out=None) -> dict:
         print(f"  jobs: {len(by_job)}  batches: {len(batches)}  "
               f"engine cache: {cache['hit']} hit / {cache['miss']} miss"
               f"  evictions: {len(evicts)}", file=out)
+        if waits:
+            print(f"  queue wait: p50 {ssum['queue_wait_p50_s']}s  "
+                  f"p95 {ssum['queue_wait_p95_s']}s", file=out)
+        if deadlines:
+            ttl = sum(1 for r in deadlines
+                      if r.get("kind") == "queue_ttl")
+            print(f"  deadline expiries: {len(deadlines)} "
+                  f"(queue_ttl x{ttl}, running x{len(deadlines) - ttl})",
+                  file=out)
+        if retries:
+            bisects = sum(1 for r in retries
+                          if r.get("event") == "bisect")
+            exhausted = sum(1 for r in retries
+                            if r.get("event") == "exhausted")
+            print(f"  batch retries: {ssum['batch_retries']}  "
+                  f"bisections: {bisects}  exhausted: {exhausted}",
+                  file=out)
         for job_id in sorted(by_job):
             rows = by_job[job_id]
             last = rows[-1]
@@ -468,9 +516,11 @@ def summarize(recs: list[dict], out=None) -> dict:
                       if run is not None and run.get("cache") else "")
             ev = sum(1 for r in rows if r.get("state") == "evicted")
             evs = f"  evicted x{ev}" if ev else ""
+            rt = (f"  retries x{retry_by_job[job_id]}"
+                  if job_id in retry_by_job else "")
             fin = "  [finished early]" if last.get("finished_early") else ""
             print(f"  {job_id}: {last.get('state')}{lane}{cached}{evs}"
-                  f"{wall}{fin}", file=out)
+                  f"{rt}{wall}{fin}", file=out)
     if rings:
         # Fleet runs tag each ring row with its experiment id (``exp``):
         # group the per-window stats PER EXPERIMENT — mixing lanes would

@@ -19,13 +19,24 @@ population, and verifies the acceptance gates of the serve plane
 4. **graceful shutdown**: SIGTERM drains the daemon and exits
    EXIT_SERVE_SHUTDOWN.
 
+``--resilience`` runs a second daemon (own spool, tight memory budget,
+``--queue-depth 2``) and proves the serve-plane resilience gates on ONE
+run: a job admitted as ``waiting_headroom`` (fits idle, not the live
+headroom) completes bit-identical to solo once the resident batch
+drains; a submission past the queue cap is rejected ``queue_full`` with
+retry-after advice (EXIT_QUEUE_FULL taxonomy); a ``--queue-ttl-s`` job
+that never got a lane expires ``deadline_expired``; and a batch killed
+by an injected transient crash (SHADOW1_SERVE_CRASH_BATCH) retries from
+its last committed generation and still bit-matches solo.
+
 Exit codes: 0 = all gates pass; 3 = digest divergence (the fleetprobe
 convention — a determinism bug, not a serve bug); 1 = any other failure.
 
 Usage::
 
     python -m shadow1_tpu.tools.serveprobe CONFIG --seeds 5,6 \
-        [--overbudget BIGCONFIG] [--mem-bytes N] [--windows W] [--json-only]
+        [--overbudget BIGCONFIG] [--mem-bytes N] [--windows W] \
+        [--resilience] [--json-only]
 
 CONFIG needs ``engine: {metrics_ring: W, state_digest: 1}`` so both the
 daemon lanes and the solo reference emit the digest stream.
@@ -84,6 +95,172 @@ def _served_stream(spool_dir: str, job_id: str) -> dict[int, tuple]:
     return out
 
 
+def _wait_state(spool_dir: str, job_id: str, states: tuple,
+                timeout_s: float) -> dict | None:
+    from shadow1_tpu.serve.protocol import Spool
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = Spool(spool_dir).read_status(job_id) or {}
+        if st.get("state") in states:
+            return st
+        time.sleep(0.05)
+    return None
+
+
+def _resilience_phase(cfgs, work, env, args, say):
+    """The queued-admission / deadline / retry gate (docs ISSUE: all on
+    ONE daemon run). Returns (error_message_or_None, verdict_dict)."""
+    import yaml
+
+    from shadow1_tpu import mem
+    from shadow1_tpu.config.experiment import load_experiment
+    from shadow1_tpu.consts import (
+        EXIT_DEADLINE,
+        EXIT_QUEUE_FULL,
+        EXIT_SERVE_SHUTDOWN,
+    )
+    from shadow1_tpu.serve import client
+    from shadow1_tpu.serve.protocol import Spool, request
+
+    verdict = {}
+    exp, params, _ = load_experiment(cfgs[0])
+    est = mem.estimate(exp, params, n_exp=1).peak_bytes
+    if est <= 0:
+        return "memory estimator returned no estimate", verdict
+    spool = os.path.join(work, "spool_resilience")
+    crash_path = os.path.join(work, "crash_count")
+    with open(crash_path, "w") as f:
+        f.write("0")
+    # One resident tenant fits with room to spare; two do not — the
+    # second admission must queue as waiting_headroom, never reject.
+    env2 = dict(env)
+    env2["SHADOW1_MEM_BYTES"] = str(int(est * 1.5))
+    env2["SHADOW1_SERVE_RETRY_BACKOFF_S"] = "0.05"
+    env2["SHADOW1_SERVE_CRASH_BATCH"] = crash_path
+    # a TTL tenant in its own shape class: never packs into anyone's
+    # batch, so it genuinely waits (and expires) in the queue
+    with open(cfgs[0]) as f:
+        doc = yaml.safe_load(f.read())
+    doc.setdefault("general", {})["seed"] = 99
+    eng = doc.setdefault("engine", {})
+    eng["ev_cap"] = int(eng.get("ev_cap", 32)) * 2
+    ttl_cfg = os.path.join(work, "ttl.yaml")
+    with open(ttl_cfg, "w") as f:
+        yaml.safe_dump(doc, f)
+
+    err_path = os.path.join(work, "daemon2.stderr")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "shadow1_tpu", "serve", "--spool", spool,
+         "--poll-s", "0.05", "--queue-depth", "2",
+         "--ckpt-every-s", "0.05"],
+        env=env2, stdout=subprocess.DEVNULL, stderr=open(err_path, "w"))
+    try:
+        deadline = time.monotonic() + 60
+        while Spool(spool).daemon_alive() is None:
+            if daemon.poll() is not None or time.monotonic() > deadline:
+                return (f"resilience daemon did not start "
+                        f"(rc={daemon.poll()})"), verdict
+            time.sleep(0.1)
+        say("[serveprobe] resilience daemon up "
+            f"(budget {mem.fmt_bytes(int(est * 1.5))}, queue-depth 2)")
+
+        # A long resident batch to queue behind.
+        j_a = client.submit(spool, cfgs[0], windows=300)
+        if _wait_state(spool, j_a, ("running",), 120) is None:
+            return "long job never started running", verdict
+        # B fits idle but not live headroom -> waiting_headroom;
+        # C (own shape, low priority, tight TTL) expires in the queue;
+        # D overflows the depth-2 queue -> queue_full backpressure.
+        j_b = client.submit(spool, cfgs[1 % len(cfgs)])
+        j_c = client.submit(spool, ttl_cfg, priority=-1,
+                            queue_ttl_s=0.35)
+        j_d = client.submit(spool, cfgs[0])
+
+        st_b = _wait_state(spool, j_b, ("waiting_headroom",), 120)
+        if st_b is None:
+            return ("second tenant never reached waiting_headroom "
+                    f"(status {Spool(spool).read_status(j_b)})"), verdict
+        verdict["waiting_headroom"] = True
+        say("[serveprobe] tenant B admitted waiting_headroom behind the "
+            "resident batch")
+
+        st_d = _wait_state(spool, j_d, ("rejected",), 120)
+        if st_d is None or (st_d.get("error") or {}).get("error") \
+                != "queue_full":
+            return f"expected queue_full rejection, got {st_d}", verdict
+        if (st_d["error"].get("retry_after_s") or 0) <= 0 \
+                or client.exit_code_for(st_d) != EXIT_QUEUE_FULL:
+            return f"queue_full record lacks retry advice: {st_d}", verdict
+        verdict["queue_full"] = True
+        say(f"[serveprobe] over-cap submission rejected queue_full "
+            f"(retry after {st_d['error']['retry_after_s']}s)")
+
+        st_c = _wait_state(spool, j_c, ("failed", "done"), 120)
+        if st_c is None or st_c.get("reason") != "deadline_expired" \
+                or client.exit_code_for(st_c) != EXIT_DEADLINE:
+            return f"TTL tenant did not expire: {st_c}", verdict
+        verdict["queue_ttl_expired"] = True
+        say(f"[serveprobe] TTL tenant expired after "
+            f"{st_c['error'].get('waited_s')}s in queue")
+
+        for jid, label in ((j_a, "resident"), (j_b, "waiting")):
+            st = _wait_state(spool, jid, ("done", "failed"),
+                             args.timeout_s)
+            if st is None or st.get("state") != "done":
+                return f"{label} tenant did not complete: {st}", verdict
+
+        # Transient-crash retry on the same daemon run: the countdown
+        # file buys exactly one injected crash; the batch must retry
+        # from its last committed generation and stay bit-exact.
+        with open(crash_path, "w") as f:
+            f.write("1")
+        j_e = client.submit(spool, cfgs[1 % len(cfgs)])
+        st_e = _wait_state(spool, j_e, ("done", "failed"),
+                           args.timeout_s)
+        if st_e is None or st_e.get("state") != "done":
+            return f"crash-retried tenant did not complete: {st_e}", \
+                verdict
+        ledger = request(Spool(spool).sock_path, {"op": "ping"})["ledger"]
+        if ledger.get("batch_retries", 0) < 1:
+            return f"no batch retry recorded in ledger: {ledger}", verdict
+        verdict["transient_retried"] = True
+        verdict["ledger"] = ledger
+        say(f"[serveprobe] injected crash absorbed: "
+            f"{ledger['batch_retries']} batch retry(s)")
+
+        # Bit-exactness across ALL resilience paths on this run.
+        solo_b = _solo_stream(cfgs[1 % len(cfgs)], args.windows,
+                              args.timeout_s, env)
+        compared = 0
+        for jid, solo in ((j_a, _solo_stream(cfgs[0], 300,
+                                             args.timeout_s, env)),
+                          (j_b, solo_b), (j_e, solo_b)):
+            served = _served_stream(spool, jid)
+            common = sorted(set(served) & set(solo))
+            if not common:
+                return f"job {jid}: no comparable windows", verdict
+            bad = [w for w in common if served[w] != solo[w]]
+            if bad:
+                return (f"job {jid} diverges from solo at window "
+                        f"{bad[0]}"), verdict
+            compared += len(common)
+        verdict["bit_exact_jobs"] = 3
+        verdict["windows_compared"] = compared
+        say(f"[serveprobe] 3 resilience-path jobs bit-identical to solo "
+            f"({compared} windows)")
+
+        daemon.send_signal(signal.SIGTERM)
+        rc = daemon.wait(timeout=60)
+        if rc != EXIT_SERVE_SHUTDOWN:
+            return f"resilience daemon drain rc={rc}", verdict
+        return None, verdict
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="shadow1_tpu.tools.serveprobe")
     ap.add_argument("config", help="YAML experiment file (must carry "
@@ -99,6 +276,12 @@ def main(argv=None) -> int:
                     help="SHADOW1_MEM_BYTES for the daemon (the CPU "
                          "backend reports no device memory)")
     ap.add_argument("--windows", type=int, default=None)
+    ap.add_argument("--resilience", action="store_true",
+                    help="also prove the resilience gates on a second "
+                         "daemon: waiting_headroom admission, queue_full "
+                         "backpressure, --queue-ttl-s expiry and "
+                         "injected-transient-crash retry, all "
+                         "bit-compared against solo runs")
     ap.add_argument("--timeout-s", type=float, default=600.0)
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args(argv)
@@ -227,6 +410,14 @@ def main(argv=None) -> int:
             return fail(f"daemon drain: expected EXIT_SERVE_SHUTDOWN="
                         f"{EXIT_SERVE_SHUTDOWN}, got rc={rc}")
         say(f"[serveprobe] daemon drained cleanly (rc={rc})")
+
+        resilience = None
+        if args.resilience:
+            err, resilience = _resilience_phase(cfgs, work, env, args,
+                                                say)
+            if err:
+                return fail(f"resilience gate: {err}",
+                            resilience=resilience)
         print(json.dumps({
             "ok": True,
             "jobs": len(job_ids),
@@ -235,6 +426,7 @@ def main(argv=None) -> int:
             "cache_hits": ledger.get("cache_hits", 0),
             "rejected_overbudget": bool(rejected),
             "shutdown_rc": rc,
+            "resilience": resilience,
         }))
         return 0
     finally:

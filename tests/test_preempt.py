@@ -66,14 +66,16 @@ def _truncate(path):
 
 def test_exit_code_taxonomy():
     from shadow1_tpu.consts import (
+        EXIT_DEADLINE,
         EXIT_MEMORY,
+        EXIT_QUEUE_FULL,
         EXIT_SERVE_SHUTDOWN,
         EXIT_SERVE_SPOOL,
     )
 
     codes = (EXIT_OK, EXIT_CONFIG, EXIT_CAPACITY, EXIT_PREEMPTED,
              EXIT_HUNG, EXIT_MEMORY, EXIT_SERVE_SHUTDOWN,
-             EXIT_SERVE_SPOOL)
+             EXIT_SERVE_SPOOL, EXIT_QUEUE_FULL, EXIT_DEADLINE)
     assert len(set(codes)) == len(codes), "codes must be distinct"
     assert set(EXIT_CODES) == set(codes), "every code documented"
     # Codes must stay clear of shell/signal conventions: 1 is a generic

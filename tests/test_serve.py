@@ -36,8 +36,10 @@ from shadow1_tpu.config.experiment import load_experiment
 from shadow1_tpu.consts import (
     EXIT_CAPACITY,
     EXIT_CONFIG,
+    EXIT_DEADLINE,
     EXIT_MEMORY,
     EXIT_OK,
+    EXIT_QUEUE_FULL,
     EXIT_SERVE_SHUTDOWN,
 )
 from shadow1_tpu.core.digest import DIGEST_FIELDS
@@ -380,17 +382,30 @@ def test_serve_registry_and_prometheus():
     from shadow1_tpu.telemetry.registry import (
         RECORD_TYPES,
         REC_SERVE,
+        REC_SERVE_DEADLINE,
         REC_SERVE_JOB,
+        REC_SERVE_QUEUE,
+        REC_SERVE_RETRY,
         SERVE_SPECS,
         to_prometheus,
     )
 
     assert REC_SERVE in RECORD_TYPES and REC_SERVE_JOB in RECORD_TYPES
-    text = to_prometheus({"jobs_done": 3, "jobs_queued": 2},
+    for rec in (REC_SERVE_QUEUE, REC_SERVE_DEADLINE, REC_SERVE_RETRY):
+        assert rec in RECORD_TYPES
+    text = to_prometheus({"jobs_done": 3, "jobs_queued": 2,
+                          "queue_depth": 4, "oldest_wait_s": 1.5,
+                          "batch_retries": 2, "jobs_expired": 1},
                          prefix="shadow1_serve", specs=SERVE_SPECS)
     assert "shadow1_serve_jobs_done_total 3" in text      # counter
     assert "shadow1_serve_jobs_queued 2" in text          # gauge, no _total
     assert "shadow1_serve_jobs_queued_total" not in text
+    # backpressure gauges stay gauges; retry/expiry surfaces are counters
+    assert "shadow1_serve_queue_depth 4" in text
+    assert "shadow1_serve_queue_depth_total" not in text
+    assert "shadow1_serve_oldest_wait_s 1.5" in text
+    assert "shadow1_serve_batch_retries_total 2" in text
+    assert "shadow1_serve_jobs_expired_total 1" in text
 
 
 def test_report_serve_section(capsys):
@@ -410,6 +425,14 @@ def test_report_serve_section(capsys):
         {"type": "serve_job", "job": "a", "state": "evicted", "t": 3.0},
         {"type": "serve_job", "job": "a", "state": "done", "t": 9.0},
         {"type": "serve_job", "job": "b", "state": "rejected", "t": 1.0},
+        {"type": "serve_queue", "event": "enqueue", "job": "a",
+         "depth": 1, "bytes": 100, "t": 1.0},
+        {"type": "serve_deadline", "job": "c", "kind": "queue_ttl",
+         "waited_s": 0.4, "t": 4.0},
+        {"type": "serve_retry", "event": "retry", "batch": "b1",
+         "jobs": ["a"], "attempt": 1, "backoff_s": 0.5, "t": 5.0},
+        {"type": "serve_retry", "event": "bisect", "batch": "b1",
+         "jobs": ["a", "c"], "attempt": 2, "t": 6.0},
     ]
     out = io.StringIO()
     summary = summarize(recs, out=out)
@@ -417,9 +440,16 @@ def test_report_serve_section(capsys):
     assert s["jobs"] == 2 and s["batches"] == 2
     assert s["cache_hits"] == 1 and s["cache_misses"] == 1
     assert s["evictions"] == 1
+    assert s["deadline_expiries"] == 1 and s["batch_retries"] == 1
+    assert s["queue_wait_p50_s"] == 1.0  # job a: queued t=1 -> running t=2
+    assert s["retries_by_job"] == {"a": 1}
     text = out.getvalue()
     assert "serve (daemon job ledger)" in text
     assert "evicted x1" in text and "wall 8.0s" in text
+    assert "queue wait: p50 1.0s" in text
+    assert "deadline expiries: 1 (queue_ttl x1, running x0)" in text
+    assert "batch retries: 1  bisections: 1" in text
+    assert "retries x1" in text
 
 
 def test_client_exit_taxonomy():
@@ -433,6 +463,345 @@ def test_client_exit_taxonomy():
         {"state": "failed", "reason": "capacity"}) == EXIT_CAPACITY
     assert client.exit_code_for(
         {"state": "failed", "reason": "memory_exhausted"}) == EXIT_MEMORY
+    assert client.exit_code_for(
+        {"state": "rejected",
+         "error": {"error": "queue_full",
+                   "retry_after_s": 0.5}}) == EXIT_QUEUE_FULL
+    assert client.exit_code_for(
+        {"state": "failed", "reason": "deadline_expired",
+         "error": {"error": "deadline_expired",
+                   "kind": "queue_ttl"}}) == EXIT_DEADLINE
+    assert client.exit_code_for(
+        {"state": "failed", "reason": "deadline_expired",
+         "error": {"error": "deadline_expired",
+                   "kind": "running"}}) == EXIT_DEADLINE
+
+
+# ---------------------------------------------------------------------------
+# two-tier admission: waiting_headroom + queue_full backpressure
+# ---------------------------------------------------------------------------
+
+def _serve_events(spool_root) -> list[dict]:
+    try:
+        with open(Spool(spool_root).log_path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        return []
+
+
+def test_waiting_headroom_admitted_then_bitexact(tmp_path, daemon,
+                                                 monkeypatch):
+    a_cfg = write_cfg(tmp_path, "a.yaml", seed=5, stop=200)
+    b_cfg = write_cfg(tmp_path, "b.yaml", seed=6, stop=40)
+    with open(b_cfg) as f:
+        est = daemon._validate({"id": "probe",
+                                "config_yaml": f.read()}).est_peak
+    assert est > 0
+    # Budget fits ONE resident tenant with headroom to spare, but not
+    # two: the second submission fits an idle device, so it must queue
+    # as waiting_headroom — never be rejected for someone else's load.
+    monkeypatch.setenv("SHADOW1_MEM_BYTES", str(int(est * 1.5)))
+    j_a = client.submit(daemon.spool.root, a_cfg)
+
+    seen = {}
+
+    def late_submit():
+        time.sleep(0.3)  # lands mid-batch; intake runs at a boundary
+        jid = client.submit(daemon.spool.root, b_cfg)
+        seen["b"] = jid
+
+    t = threading.Thread(target=late_submit)
+    t.start()
+    daemon.step()   # A's batch; B admitted waiting_headroom mid-flight
+    t.join()
+    j_b = seen["b"]
+    st_b = daemon.spool.read_status(j_b)
+    assert st_b["state"] == "waiting_headroom", st_b
+    assert daemon.ledger_dict()["jobs_waiting"] == 1
+    daemon.step()   # A drained the device; B is schedulable now
+    assert daemon.spool.read_status(j_a)["state"] == "done"
+    assert daemon.spool.read_status(j_b)["state"] == "done"
+    assert served_stream(daemon.spool.root, j_a) == solo_stream(a_cfg)
+    assert served_stream(daemon.spool.root, j_b) == solo_stream(b_cfg)
+    # the queue plane narrated the wait
+    assert any(e.get("type") == "serve_queue"
+               and e.get("event") == "waiting_headroom"
+               for e in _serve_events(daemon.spool.root))
+
+
+def test_queue_full_depth_cap_rejects_with_retry_advice(tmp_path):
+    d = ServeDaemon(str(tmp_path / "sp"), poll_s=0.05, ckpt_every_s=1e9,
+                    queue_depth=1)
+    d.start()
+    try:
+        j1 = client.submit(d.spool.root, write_cfg(tmp_path, "a.yaml",
+                                                   seed=5))
+        j2 = client.submit(d.spool.root, write_cfg(tmp_path, "b.yaml",
+                                                   seed=6))
+        d._intake()
+        assert (d.spool.read_status(j1) or {}).get("state") == "queued"
+        st2 = d.spool.read_status(j2)
+        assert st2["state"] == "rejected", st2
+        err = st2["error"]
+        assert err["error"] == "queue_full" and err["cap"] == "depth"
+        assert err["queue_depth"] == err["queue_depth_cap"] == 1
+        assert err["retry_after_s"] > 0
+        assert client.exit_code_for(st2) == EXIT_QUEUE_FULL
+        led = d.ledger_dict()
+        assert led["jobs_queue_full"] == 1 and led["queue_depth"] == 1
+    finally:
+        d.close()
+
+
+def test_queue_full_bytes_cap_rejects(tmp_path):
+    probe = ServeDaemon(str(tmp_path / "probe_sp"))
+    with open(write_cfg(tmp_path, "a.yaml", seed=5)) as f:
+        est = probe._validate({"id": "p", "config_yaml": f.read()}).est_peak
+    assert est > 0
+    d = ServeDaemon(str(tmp_path / "sp"), poll_s=0.05, ckpt_every_s=1e9,
+                    queue_bytes=est)
+    d.start()
+    try:
+        j1 = client.submit(d.spool.root, write_cfg(tmp_path, "c.yaml",
+                                                   seed=7))
+        j2 = client.submit(d.spool.root, write_cfg(tmp_path, "d.yaml",
+                                                   seed=8))
+        d._intake()
+        assert (d.spool.read_status(j1) or {}).get("state") == "queued"
+        st2 = d.spool.read_status(j2)
+        assert st2["state"] == "rejected", st2
+        assert st2["error"]["error"] == "queue_full"
+        assert st2["error"]["cap"] == "bytes"
+        assert st2["error"]["queue_bytes_cap"] == est
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# deadlines: queue TTL + running wall-time bound (committed prefix)
+# ---------------------------------------------------------------------------
+
+def test_queue_ttl_expires_waiting_job(tmp_path, daemon):
+    a_cfg = write_cfg(tmp_path, "a.yaml", seed=5, stop=100)
+    # different shape class (ev_cap) — never packs into A's batch, so it
+    # sits queued while A runs and crosses its TTL at a chunk boundary
+    t_cfg = write_cfg(tmp_path, "t.yaml", seed=6, ev_cap=64)
+    j_a = client.submit(daemon.spool.root, a_cfg)
+    j_t = client.submit(daemon.spool.root, t_cfg, queue_ttl_s=0.01)
+    daemon.step()
+    st_t = daemon.spool.read_status(j_t)
+    assert st_t["state"] == "failed", st_t
+    assert st_t["reason"] == "deadline_expired"
+    assert st_t["error"]["kind"] == "queue_ttl"
+    assert st_t["error"]["waited_s"] > 0.01
+    assert client.exit_code_for(st_t) == EXIT_DEADLINE
+    assert daemon.ledger_dict()["jobs_expired"] == 1
+    recs = Spool(daemon.spool.root).read_results(j_t)
+    assert any(r.get("type") == "serve_deadline"
+               and r.get("kind") == "queue_ttl" for r in recs)
+    assert daemon.spool.read_status(j_a)["state"] == "done"
+
+
+def test_running_deadline_keeps_prefix_cohabitant_unharmed(tmp_path,
+                                                           daemon):
+    a_cfg = write_cfg(tmp_path, "a.yaml", seed=5, stop=200)
+    b_cfg = write_cfg(tmp_path, "b.yaml", seed=6, stop=200)
+    j_a = client.submit(daemon.spool.root, a_cfg, deadline_s=0.05)
+    j_b = client.submit(daemon.spool.root, b_cfg)
+    daemon.step()   # shared batch; A expires at the first chunk boundary
+    st_a = daemon.spool.read_status(j_a)
+    assert st_a["state"] == "failed", st_a
+    assert st_a["reason"] == "deadline_expired"
+    assert st_a["error"]["kind"] == "running"
+    assert st_a["error"]["ran_s"] > 0.05
+    assert client.exit_code_for(st_a) == EXIT_DEADLINE
+    # the committed prefix survives, bit-identical to the same prefix of
+    # the straight solo run — a deadline is a bound, not a rollback
+    solo_a = solo_stream(a_cfg)
+    prefix = served_stream(daemon.spool.root, j_a)
+    assert prefix and len(prefix) < len(solo_a)
+    assert all(solo_a[w] == row for w, row in prefix.items())
+    daemon.step()   # B resumes from the sliced snapshot
+    st_b = daemon.spool.read_status(j_b)
+    assert st_b["state"] == "done", st_b
+    assert served_stream(daemon.spool.root, j_b) == solo_stream(b_cfg)
+    # the cohabitant resumed from the lane-sliced checkpoint, not a
+    # from-scratch rerun
+    assert not any(e.get("event") == "cursor_discarded"
+                   for e in _serve_events(daemon.spool.root))
+
+
+# ---------------------------------------------------------------------------
+# transient-failure retry + blast-radius bisection
+# ---------------------------------------------------------------------------
+
+def test_transient_crash_retries_bitexact(tmp_path, daemon, monkeypatch):
+    import shadow1_tpu.fleet.run as fleet_run
+
+    real = fleet_run.run_fleet
+    calls = []
+
+    def flaky(engine, st=None, **kw):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("injected transport flake")
+        return real(engine, st, **kw)
+
+    monkeypatch.setattr(fleet_run, "run_fleet", flaky)
+    monkeypatch.setenv("SHADOW1_SERVE_RETRY_BACKOFF_S", "0")
+    cfg = write_cfg(tmp_path, "a.yaml", seed=5)
+    jid = client.submit(daemon.spool.root, cfg)
+    assert daemon.step()   # crash -> scheduled for retry, NOT failed
+    st = daemon.spool.read_status(jid)
+    assert st["state"] == "queued" and st.get("retrying"), st
+    assert daemon.ledger_dict()["batch_retries"] == 1
+    assert daemon.resume, "transient crash must leave a retry cursor"
+    assert daemon.step()   # the retry attempt
+    assert daemon.spool.read_status(jid)["state"] == "done"
+    assert served_stream(daemon.spool.root, jid) == solo_stream(cfg)
+    assert any(e.get("type") == "serve_retry" and e.get("event") == "retry"
+               for e in _serve_events(daemon.spool.root))
+
+
+def test_deterministic_failure_not_retried(tmp_path, daemon, monkeypatch):
+    import shadow1_tpu.fleet.run as fleet_run
+    from shadow1_tpu.txn import SelfCheckError
+
+    def det(engine, st=None, **kw):
+        raise SelfCheckError({"pkts_sent": 1}, 1, "injected")
+
+    monkeypatch.setattr(fleet_run, "run_fleet", det)
+    jid = client.submit(daemon.spool.root,
+                        write_cfg(tmp_path, "a.yaml", seed=5))
+    assert daemon.step()
+    st = daemon.spool.read_status(jid)
+    # determinism: a self-check violation reproduces on retry, so the
+    # job fails immediately — no backoff cursor, no retry counter
+    assert st["state"] == "failed" and st["reason"] == "runtime", st
+    assert daemon.ledger_dict()["batch_retries"] == 0
+    assert not daemon.resume
+
+
+def test_bisection_isolates_poisonous_tenant(tmp_path, daemon,
+                                             monkeypatch):
+    import shadow1_tpu.fleet.run as fleet_run
+
+    real = fleet_run.run_fleet
+
+    def poisoned(engine, st=None, **kw):
+        if any(l.get("seed") == 5 for l in (kw.get("labels") or [])):
+            raise RuntimeError("poisonous tenant aboard")
+        return real(engine, st, **kw)
+
+    monkeypatch.setattr(fleet_run, "run_fleet", poisoned)
+    monkeypatch.setenv("SHADOW1_SERVE_RETRY_BACKOFF_S", "0")
+    bad_cfg = write_cfg(tmp_path, "bad.yaml", seed=5)
+    ok_cfg = write_cfg(tmp_path, "ok.yaml", seed=6)
+    j_bad = client.submit(daemon.spool.root, bad_cfg)
+    j_ok = client.submit(daemon.spool.root, ok_cfg)
+    assert daemon.step()   # crash #1: the pair retries together
+    assert daemon.ledger_dict()["batch_retries"] == 1
+    assert daemon.step()   # crash #2: bisect the suspects into solos
+    assert daemon.ledger_dict()["jobs_bisected"] == 2
+    for j in (j_bad, j_ok):
+        st = daemon.spool.read_status(j)
+        assert st["state"] == "queued" and st.get("solo"), st
+    assert daemon.step()   # j_bad solo -> crash #3 -> retries exhausted
+    st_bad = daemon.spool.read_status(j_bad)
+    assert st_bad["state"] == "failed", st_bad
+    assert st_bad["reason"] == "retry_exhausted"
+    assert len(st_bad["error"]["crashes"]) == 3  # the full crash ledger
+    assert client.exit_code_for(st_bad) == 1
+    assert daemon.step()   # j_ok solo runs clean, bit-exact
+    st_ok = daemon.spool.read_status(j_ok)
+    assert st_ok["state"] == "done", st_ok
+    assert served_stream(daemon.spool.root, j_ok) == solo_stream(ok_cfg)
+    assert any(e.get("type") == "serve_retry"
+               and e.get("event") == "bisect"
+               for e in _serve_events(daemon.spool.root))
+
+
+# ---------------------------------------------------------------------------
+# NFS-safe spool locking: stale-lock reclaim vs live-lock refusal
+# ---------------------------------------------------------------------------
+
+def test_stale_lock_reclaimed_live_lock_refused(tmp_path):
+    import socket as socketlib
+
+    from shadow1_tpu.serve.daemon import SpoolError
+
+    spool = Spool(str(tmp_path / "s")).ensure()
+    # a SIGKILLed same-host daemon: dead pid, heartbeat still fresh —
+    # the pid check is authoritative on the same host
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    with open(spool.daemon_path, "w") as f:
+        json.dump({"pid": p.pid, "host": socketlib.gethostname(),
+                   "started_at": time.time(),
+                   "heartbeat_at": time.time()}, f)
+    assert spool.daemon_alive() is None
+    d = ServeDaemon(str(tmp_path / "s"))
+    d.start()   # reclaims instead of refusing
+    try:
+        assert any(e.get("event") == "lock_reclaimed"
+                   for e in _serve_events(spool.root))
+    finally:
+        d.close()
+    # a live cross-host holder (NFS spool: its flock is not visible
+    # here) with a fresh heartbeat: refused
+    with open(spool.daemon_path, "w") as f:
+        json.dump({"pid": 1, "host": "elsewhere.example",
+                   "heartbeat_at": time.time()}, f)
+    with pytest.raises(SpoolError):
+        ServeDaemon(str(tmp_path / "s")).start()
+    # the same cross-host holder gone silent past STALE_AFTER_S:
+    # reclaimable (mtime pushed back too — it IS the heartbeat)
+    old = time.time() - 3600
+    with open(spool.daemon_path, "w") as f:
+        json.dump({"pid": 1, "host": "elsewhere.example",
+                   "heartbeat_at": old, "started_at": old}, f)
+    os.utime(spool.daemon_path, (old, old))
+    d3 = ServeDaemon(str(tmp_path / "s"))
+    d3.start()
+    d3.close()
+
+
+# ---------------------------------------------------------------------------
+# client reconnect hardening
+# ---------------------------------------------------------------------------
+
+def test_client_request_retry_reconnects(tmp_path, capsys):
+    import socket as socketlib
+
+    sock_path = str(tmp_path / "x.sock")
+    srv = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(2)
+
+    def flap_then_serve():
+        c1, _ = srv.accept()
+        c1.close()          # the flap: drop the first connection cold
+        c2, _ = srv.accept()
+        f = c2.makefile("rw", encoding="utf-8")
+        f.readline()
+        f.write('{"ok": true}\n')
+        f.flush()
+        c2.close()
+
+    t = threading.Thread(target=flap_then_serve, daemon=True)
+    t.start()
+    out = client.request_retry(sock_path, {"op": "ping"}, attempts=4,
+                               base_s=0.01)
+    t.join(timeout=5)
+    srv.close()
+    assert out == {"ok": True}
+    assert '"reconnected"' in capsys.readouterr().err
+
+
+def test_client_watch_falls_back_on_dead_socket(tmp_path):
+    # exhausted reconnect budget -> None, the await_job polling fallback
+    assert client.watch(str(tmp_path / "absent.sock"), "j", attempts=2,
+                        base_s=0.01, timeout_s=2.0) is None
 
 
 # ---------------------------------------------------------------------------
